@@ -1,0 +1,23 @@
+"""Pallas TPU kernel for large-N magnitude top-k (torch.topk CUDA parity).
+
+Status: the dedicated kernel is not implemented yet; `select_topk(...,
+method="pallas")` raises with a pointer to the supported methods. The lax
+formulations in ops/topk.py ("exact"/"blockwise") are the production paths
+until profiling on hardware justifies the hand-written kernel (SURVEY.md §7
+build-order step 6).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+
+Array = jax.Array
+
+
+def pallas_topk_abs(x: Array, k: int) -> Tuple[Array, Array]:
+    raise NotImplementedError(
+        "the Pallas top-k kernel is not implemented yet; use "
+        "method='blockwise' (exact, TPU-friendly) or 'exact'"
+    )

@@ -1,0 +1,190 @@
+"""Top-k selection and sparse (index, value) set algebra, shape-static for XLA.
+
+Reference parity: the reference compressor (compression.py::TopKCompressor in
+hclhkbu/gtopkssgd) calls `torch.topk(|acc|, k)` on GPU over the flat gradient
+(N up to ~1e8 for ResNet-50) and the allreducer merges (index, value) pairs in
+numpy on the host. Here both live on the TPU:
+
+  * `topk_abs`           -- exact magnitude top-k via `lax.top_k` (one shot).
+  * `blockwise_topk_abs` -- exact two-stage top-k: per-block candidates then a
+                            global reselect.  Much friendlier to the TPU VPU
+                            for large N because each `lax.top_k` call runs on
+                            a short row of a 2-D batch instead of one huge
+                            vector. Used by default for N above a threshold.
+  * `approx_topk_abs`    -- `lax.approx_max_k` (TPU-optimized, recall<1);
+                            opt-in, changes semantics slightly.
+  * `merge_sparse_sets`  -- the per-round merge of the gTop-k tree: sparse sum
+                            of two k-sized unique-index sets, then reselect.
+
+Sparse sets are a pair of arrays `(values f32[k], indices i32[k])` with unique
+indices; padding slots use `index == n` (one past the end) with value 0 so a
+`scatter(..., mode='drop')` ignores them.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+SENTINEL_DTYPE = jnp.int32
+
+Array = jax.Array
+
+
+def k_for_density(n: int, density: float) -> int:
+    """k = max(1, ceil(density * n)) — matches the reference's k choice."""
+    return max(1, int(math.ceil(float(density) * n)))
+
+
+def topk_abs(x: Array, k: int) -> Tuple[Array, Array]:
+    """Exact top-k of |x| over a flat vector. Returns (signed values, indices).
+
+    Indices are int32. Output is ordered by descending |value| (ties broken by
+    `lax.top_k`'s deterministic lowest-index-first rule, which is what makes
+    the SPMD-symmetric gtopk merge produce identical results on every device).
+    """
+    mag = jnp.abs(x)
+    _, idx = lax.top_k(mag, k)
+    idx = idx.astype(SENTINEL_DTYPE)
+    vals = jnp.take(x, idx, mode="fill", fill_value=0)
+    return vals, idx
+
+
+def blockwise_topk_abs(x: Array, k: int, num_blocks: int = 0) -> Tuple[Array, Array]:
+    """Exact top-k of |x| using a two-stage (per-block, then global) select.
+
+    Stage 1 reshapes the flat N-vector into (B, ceil(N/B)) rows and takes the
+    top-min(k, row) of each row in one batched `lax.top_k`; stage 2 reselects
+    the global top-k among the <= B*k candidates. Exactness: every global
+    top-k element is necessarily in its own block's top-k.
+
+    This is the lax formulation of the two-stage kernel strategy listed in
+    SURVEY.md §2 (native obligations table) for the `torch.topk` replacement;
+    the Pallas version lives in `ops/pallas_topk.py`.
+    """
+    n = x.shape[0]
+    if num_blocks <= 0:
+        # Heuristic: rows of ~64k elements keep each top-k call cheap while
+        # stage 2 stays small (B * k candidates).
+        num_blocks = max(1, n // 65536)
+    block = -(-n // num_blocks)  # ceil
+    padded = block * num_blocks
+    kb = min(k, block)
+    xp = jnp.pad(x, (0, padded - n))
+    mag = jnp.abs(xp).reshape(num_blocks, block)
+    # In-block positions of per-block candidates.
+    _, pos = lax.top_k(mag, kb)  # (B, kb)
+    base = (jnp.arange(num_blocks, dtype=SENTINEL_DTYPE) * block)[:, None]
+    cand_idx = (pos.astype(SENTINEL_DTYPE) + base).reshape(-1)
+    cand_val = jnp.take(xp, cand_idx).reshape(-1)
+    # Padding elements are 0 and sort last; mask them to sentinel after select.
+    _, sel = lax.top_k(jnp.abs(cand_val), k)
+    idx = jnp.take(cand_idx, sel)
+    vals = jnp.take(cand_val, sel)
+    oob = idx >= n
+    idx = jnp.where(oob, n, idx).astype(SENTINEL_DTYPE)
+    vals = jnp.where(oob, 0.0, vals)
+    return vals, idx
+
+
+def approx_topk_abs(x: Array, k: int, recall_target: float = 0.95) -> Tuple[Array, Array]:
+    """TPU-optimized approximate top-k (`lax.approx_max_k`). Opt-in only:
+    recall < 1 slightly changes gTop-k semantics (still convergent thanks to
+    error feedback, but document any use in experiments)."""
+    mag = jnp.abs(x)
+    _, idx = lax.approx_max_k(mag, k, recall_target=recall_target)
+    idx = idx.astype(SENTINEL_DTYPE)
+    vals = jnp.take(x, idx, mode="fill", fill_value=0)
+    return vals, idx
+
+
+_METHODS = {
+    "exact": lambda x, k: topk_abs(x, k),
+    "blockwise": lambda x, k: blockwise_topk_abs(x, k),
+    "approx": lambda x, k: approx_topk_abs(x, k),
+}
+
+
+def select_topk(x: Array, k: int, method: str = "auto") -> Tuple[Array, Array]:
+    """Dispatch on top-k strategy. "auto" = blockwise for large N (the regime
+    where a single monolithic `lax.top_k` call underuses the VPU), else exact.
+    """
+    if method == "auto":
+        method = "blockwise" if x.shape[0] >= 1 << 20 else "exact"
+    if method == "pallas":
+        from gtopkssgd_tpu.ops.pallas_topk import pallas_topk_abs
+
+        return pallas_topk_abs(x, k)
+    try:
+        fn = _METHODS[method]
+    except KeyError:
+        raise ValueError(f"unknown topk method {method!r}") from None
+    return fn(x, k)
+
+
+def merge_sparse_sets(
+    vals_a: Array,
+    idx_a: Array,
+    vals_b: Array,
+    idx_b: Array,
+    k: int,
+    n: int,
+) -> Tuple[Array, Array]:
+    """Sparse-sum two unique-index sets and reselect the top-k by magnitude.
+
+    This is one round of the gTop-k tree (allreducer.py::gtopk_sparse_allreduce
+    in the reference, Algorithm 2 of arXiv:1901.04359): concatenate the two
+    (value, index) lists, sum duplicated indices, take top-k of the <=2k
+    candidates.  Both partners of a `ppermute` exchange call this on the same
+    multiset (in different concatenation order), and the result is
+    order-canonical, so all devices stay in lockstep without a re-broadcast:
+
+      * pairs are sorted by index, so slot layout is order-independent;
+      * duplicate (real) indices appear at most twice because each input set
+        has unique real indices; the pair is summed into its first slot and
+        the second slot is voided to the sentinel. Sentinel (padding) slots
+        may repeat more than twice but always carry value 0, so the
+        run-length-2 assumption only ever drops zeros;
+      * the final `lax.top_k` then sees identical (value, index) arrays on
+        both partners and its tie-breaking is deterministic.
+
+    Returns (values, indices) of the merged set, descending by |value|.
+    """
+    cat_idx = jnp.concatenate([idx_a, idx_b])
+    cat_val = jnp.concatenate([vals_a, vals_b])
+    # Canonical order: sort by index; equal (duplicate) indices are adjacent.
+    order = jnp.argsort(cat_idx)
+    si = jnp.take(cat_idx, order)
+    sv = jnp.take(cat_val, order)
+    dup = jnp.concatenate([jnp.zeros((1,), bool), si[1:] == si[:-1]])
+    next_dup = jnp.concatenate([dup[1:], jnp.zeros((1,), bool)])
+    summed = sv + jnp.where(next_dup, jnp.roll(sv, -1), 0.0)
+    merged_val = jnp.where(dup, 0.0, summed)
+    merged_idx = jnp.where(dup, n, si).astype(SENTINEL_DTYPE)
+    _, sel = lax.top_k(jnp.abs(merged_val), k)
+    return jnp.take(merged_val, sel), jnp.take(merged_idx, sel)
+
+
+def scatter_add_dense(n: int, idx: Array, vals: Array, dtype=jnp.float32) -> Array:
+    """Densify a sparse set: zeros(n).at[idx].add(vals), dropping sentinel
+    slots (idx == n falls out of range and `mode='drop'` ignores it)."""
+    return jnp.zeros((n,), dtype).at[idx].add(vals.astype(dtype), mode="drop")
+
+
+def membership_mask(query_idx: Array, set_idx: Array) -> Array:
+    """bool[len(query_idx)]: is each query index present in `set_idx`?
+
+    Used for the error-feedback repair step: values selected locally but
+    rejected globally go back into the residual (`add_residuals` in the
+    reference compressor). Sentinel queries (== n) report membership iff the
+    set also carries the sentinel, but callers always mask by value anyway.
+    """
+    sorted_set = jnp.sort(set_idx)
+    pos = jnp.searchsorted(sorted_set, query_idx)
+    pos = jnp.clip(pos, 0, set_idx.shape[0] - 1)
+    return jnp.take(sorted_set, pos) == query_idx

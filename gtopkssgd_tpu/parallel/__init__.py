@@ -1,0 +1,28 @@
+"""Sparse collective communication over the TPU mesh (reference layer L1).
+
+TPU-native replacement for allreducer.py::AllReducer in hclhkbu/gtopkssgd,
+which ran mpi4py Send/Recv/Allgather/Allreduce on host-side numpy staging
+buffers from a background thread. Here every collective is an XLA op on
+HBM-resident arrays inside one jitted SPMD program: `lax.ppermute` pair
+exchanges ride ICI for the gTop-k tree, `all_gather` implements the DGC
+baseline, `psum` the dense baseline. No threads, no host staging, no D2H/H2D.
+"""
+
+from gtopkssgd_tpu.parallel.collectives import (
+    dense_allreduce,
+    gtopk_allreduce,
+    topk_allgather,
+    sparse_allreduce,
+    comm_bytes_per_step,
+)
+from gtopkssgd_tpu.parallel.mesh import make_mesh, dp_axis
+
+__all__ = [
+    "dense_allreduce",
+    "gtopk_allreduce",
+    "topk_allgather",
+    "sparse_allreduce",
+    "comm_bytes_per_step",
+    "make_mesh",
+    "dp_axis",
+]

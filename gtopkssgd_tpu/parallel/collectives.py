@@ -1,0 +1,185 @@
+"""The three reduction modes of the reference allreducer, TPU-native.
+
+Reference parity (allreducer.py in hclhkbu/gtopkssgd, SURVEY.md C5): three
+modes behind one interface —
+
+  (a) gTop-k tree  — log2(P) rounds of pairwise exchange of concatenated
+      [values; indices] buffers, merge-then-reselect each round, then a
+      reverse-tree broadcast (paper Algorithm 2).  O(k log P) per rank.
+  (b) top-k allgather (DGC baseline)               O(k P) per rank.
+  (c) dense allreduce                               O(N).
+
+TPU redesign notes:
+
+  * The reference tree is asymmetric (half the ranks go idle each round and
+    rank 0 re-broadcasts down the tree — 2 log2 P total rounds).  SPMD wants
+    symmetry, so we use the recursive-doubling (hypercube) formulation: at
+    round r every device exchanges with `rank XOR 2^r` via `lax.ppermute` and
+    both partners compute the identical merged top-k.  After log2(P) rounds
+    every device holds the same global set — the reverse broadcast vanishes
+    and total rounds HALVE vs the reference.  Equivalence: the merge
+    (sparse-sum + reselect) is commutative and order-canonical
+    (ops.topk.merge_sparse_sets), proven against a numpy oracle in
+    tests/test_collectives.py.
+
+  * All functions here run INSIDE a `jax.shard_map` body over the `dp` mesh
+    axis — they are per-device views with collectives over `axis_name`.
+
+  * gTop-k semantics (same as reference): the result is top-k of the
+    *hierarchically merged partial sums*, which is not always exactly the
+    top-k of the full dense sum — that approximation is the algorithm, and
+    error feedback compensates (arXiv:1911.08772 analyzes why this
+    converges).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gtopkssgd_tpu.ops import merge_sparse_sets, scatter_add_dense, topk_abs
+
+Array = jax.Array
+
+
+def _is_pow2(p: int) -> bool:
+    return p > 0 and (p & (p - 1)) == 0
+
+
+def gtopk_allreduce(
+    vals: Array,
+    idx: Array,
+    *,
+    k: int,
+    n: int,
+    axis_name: str,
+    axis_size: int,
+) -> Tuple[Array, Array]:
+    """Global top-k sparse allreduce over `axis_name` (hypercube ppermute).
+
+    Input: this device's local top-k set (vals f32[k], idx i32[k], unique
+    indices, sentinel = n for padding). Output: the *global* gTop-k set,
+    bit-identical on every device along the axis — values are SUMS over
+    contributing devices (divide by axis_size for an average).
+
+    Non-power-of-two axis sizes fall back to allgather + global reselect
+    (identical result to a flat merge tree; the hypercube needs 2^m ranks —
+    the reference handled ragged P with masked sends, which on ICI buys
+    nothing over the fallback).
+    """
+    if not _is_pow2(axis_size):
+        return _allgather_reselect(
+            vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size
+        )
+    rounds = int(math.log2(axis_size))
+    for r in range(rounds):
+        bit = 1 << r
+        perm = [(i, i ^ bit) for i in range(axis_size)]
+        pvals = lax.ppermute(vals, axis_name, perm)
+        pidx = lax.ppermute(idx, axis_name, perm)
+        vals, idx = merge_sparse_sets(vals, idx, pvals, pidx, k, n)
+    return vals, idx
+
+
+def _allgather_reselect(
+    vals: Array,
+    idx: Array,
+    *,
+    k: int,
+    n: int,
+    axis_name: str,
+    axis_size: int,
+) -> Tuple[Array, Array]:
+    """Gather all P local sets, sparse-sum duplicates, reselect global top-k.
+
+    Used as the ragged-P fallback for gtopk. Duplicate indices across the
+    P*k candidates are summed via a dense scatter (exact, not pairwise), then
+    reselected.  Comm is O(kP) but result semantics differ from the hypercube
+    only in being *exact* top-k of the sparse sum (a superset-quality result).
+    """
+    all_vals = lax.all_gather(vals, axis_name, tiled=True)  # (P*k,)
+    all_idx = lax.all_gather(idx, axis_name, tiled=True)
+    dense = scatter_add_dense(n, all_idx, all_vals)
+    gvals, gidx = topk_abs(dense, k)
+    # Preserve the sentinel convention for zero slots.
+    empty = gvals == 0.0
+    gidx = jnp.where(empty, n, gidx).astype(jnp.int32)
+    return gvals, gidx
+
+
+def topk_allgather(
+    vals: Array,
+    idx: Array,
+    *,
+    k: int,
+    n: int,
+    axis_name: str,
+    axis_size: int,
+) -> Array:
+    """DGC-style baseline (reference mode 'topk'/'topkA'): allgather every
+    device's local top-k and apply the union — no global reselect, so every
+    local pick lands and no residual repair is needed. Returns the DENSE
+    summed update f32[n] (the union can hold up to k*P distinct indices, so a
+    sparse fixed-k return shape does not exist for this mode)."""
+    all_vals = lax.all_gather(vals, axis_name, tiled=True)
+    all_idx = lax.all_gather(idx, axis_name, tiled=True)
+    return scatter_add_dense(n, all_idx, all_vals)
+
+
+def dense_allreduce(x: Array, *, axis_name: str) -> Array:
+    """Dense baseline: one psum over the DP axis (reference MPI.Allreduce)."""
+    return lax.psum(x, axis_name)
+
+
+def sparse_allreduce(
+    mode: str,
+    vals: Array,
+    idx: Array,
+    *,
+    k: int,
+    n: int,
+    axis_name: str,
+    axis_size: int,
+) -> Tuple[Array, Array, bool]:
+    """Mode dispatch preserving the reference's L2/L1 boundary.
+
+    Returns (result, gidx, needs_repair):
+      * 'gtopk'     -> result = gvals f32[k], gidx = i32[k], True.
+      * 'allgather' -> result = the dense summed update f32[n], gidx = None,
+                       False (the union of P local sets has variable size up
+                       to k*P, so no fixed-k sparse return shape exists; no
+                       repair because every local pick is applied).
+    This is the one place the return shape differs across modes; the
+    distributed optimizer branches on `gidx is None`.
+    """
+    if mode == "gtopk":
+        gvals, gidx = gtopk_allreduce(
+            vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size
+        )
+        return gvals, gidx, True
+    if mode in ("allgather", "topk", "topkA"):
+        dense = topk_allgather(
+            vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size
+        )
+        return dense, None, False
+    raise ValueError(f"unknown sparse allreduce mode {mode!r}")
+
+
+def comm_bytes_per_step(mode: str, n: int, k: int, p: int) -> int:
+    """Per-device communication volume model (paper §3 complexity table):
+    gtopk O(k log P), allgather O(k P), dense O(N). 8 bytes per (f32, i32)
+    element pair; dense counts 4-byte f32 once per element (ring allreduce
+    moves ~2N elements, we report the N model like the paper)."""
+    if mode == "gtopk":
+        if not _is_pow2(p):
+            return 8 * k * p
+        return 8 * k * max(1, int(math.log2(p)))
+    if mode in ("allgather", "topk", "topkA"):
+        return 8 * k * p
+    if mode in ("dense", "none", None):
+        return 4 * n
+    raise ValueError(f"unknown mode {mode!r}")

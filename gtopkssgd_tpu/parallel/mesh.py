@@ -1,0 +1,44 @@
+"""Device mesh construction for data-parallel gTop-k S-SGD.
+
+The reference's process topology is "P MPI ranks, one per GPU"
+(dist_trainer.py::main: MPI.COMM_WORLD init + rank->GPU bind). The TPU-native
+equivalent is one SPMD program over a 1-D `jax.sharding.Mesh` axis `'dp'`
+spanning every chip (single host: local devices; multi-host: call
+`jax.distributed.initialize()` first and the same code spans the pod slice).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DP_AXIS = "dp"
+
+
+def dp_axis() -> str:
+    return DP_AXIS
+
+
+def make_mesh(
+    num_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = DP_AXIS,
+) -> Mesh:
+    """1-D data-parallel mesh over `num_devices` (default: all devices).
+
+    Under tests this sees the 8 virtual CPU devices forced by conftest.py;
+    on hardware it sees the chips of the slice. ICI layout: a 1-D DP axis
+    lets XLA route ppermute pair exchanges over the torus links directly.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis_name,))

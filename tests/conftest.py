@@ -1,0 +1,32 @@
+"""Test harness: run all tests on a virtual 8-device CPU mesh.
+
+The reference (hclhkbu/gtopkssgd) had no test suite at all — multi-node
+behavior could only be exercised with a real `mpirun` launch. JAX lets us run
+real 8-way SPMD collectives in one process: force 8 host CPU devices before
+jax initializes (SURVEY.md §4).
+"""
+
+import os
+
+# Must happen before jax initializes its backends. The machine's
+# sitecustomize registers the real TPU plugin and overrides the
+# JAX_PLATFORMS env var, so we use the config API (which wins) in addition.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,92 @@
+"""Error-feedback compressor invariants (SURVEY.md §4 test strategy)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from gtopkssgd_tpu.compression import (
+    NoneCompressor,
+    TopKCompressor,
+    get_compressor,
+)
+from gtopkssgd_tpu.ops import scatter_add_dense
+
+
+def test_registry():
+    assert isinstance(get_compressor(None), NoneCompressor)
+    assert isinstance(get_compressor("none"), NoneCompressor)
+    c = get_compressor("topk", density=0.01)
+    assert isinstance(c, TopKCompressor) and c.density == 0.01
+    c = get_compressor("gtopk", density=0.001)
+    assert isinstance(c, TopKCompressor)
+
+
+def test_mass_conservation(rng):
+    """Invariant: sent + residual == acc, elementwise (no gradient mass is
+    created or destroyed by compression)."""
+    n = 4096
+    comp = TopKCompressor(density=0.01, method="exact")
+    grad = rng.standard_normal(n).astype(np.float32)
+    residual = comp.init_residual(n)
+    acc = comp.accumulate(jnp.asarray(grad), residual)
+    vals, idx, new_res = comp.compress(acc)
+    sent = scatter_add_dense(n, idx, vals)
+    np.testing.assert_allclose(
+        np.asarray(sent + new_res), np.asarray(acc), rtol=1e-6, atol=1e-7
+    )
+    # Selected slots are zeroed in the residual.
+    assert np.all(np.asarray(new_res)[np.asarray(idx)] == 0.0)
+
+
+def test_residual_accumulates_over_steps(rng):
+    """Unselected gradient mass must build up and eventually win selection —
+    the error-feedback property that preserves convergence at rho=1e-3."""
+    n = 1000
+    comp = TopKCompressor(density=0.001, method="exact")  # k = 1
+    residual = comp.init_residual(n)
+    small = np.full(n, 0.001, np.float32)
+    small[7] = 1.0  # dominant coordinate wins first
+    acc = comp.accumulate(jnp.asarray(small), residual)
+    vals, idx, residual = comp.compress(acc)
+    assert int(idx[0]) == 7
+    # Feed zero grads; residual mass alone must get selected (any non-7 slot
+    # has accumulated 0.001 and slot 7 has 0).
+    acc = comp.accumulate(jnp.zeros(n), residual)
+    vals2, idx2, residual = comp.compress(acc)
+    assert int(idx2[0]) != 7
+    assert abs(float(vals2[0]) - 0.001) < 1e-6
+
+
+def test_repair_returns_rejected_mass(rng):
+    n = 256
+    comp = TopKCompressor(density=0.05, method="exact")
+    grad = rng.standard_normal(n).astype(np.float32)
+    acc = comp.accumulate(jnp.asarray(grad), comp.init_residual(n))
+    vals, idx, res = comp.compress(acc)
+    # Pretend the global top-k kept only the first half of our local picks.
+    k = vals.shape[0]
+    global_idx = idx[: k // 2]
+    repaired = comp.repair(res, vals, idx, global_idx)
+    r = np.asarray(repaired)
+    li, lv = np.asarray(idx), np.asarray(vals)
+    kept = set(np.asarray(global_idx).tolist())
+    for i in range(k):
+        if li[i] in kept:
+            assert r[li[i]] == 0.0
+        else:
+            np.testing.assert_allclose(r[li[i]], lv[i], rtol=1e-6)
+    # After repair: residual + globally-applied == acc (global mass view).
+    applied = scatter_add_dense(n, global_idx, vals[: k // 2])
+    np.testing.assert_allclose(
+        np.asarray(applied + repaired), np.asarray(acc), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_none_compressor_passthrough(rng):
+    n = 64
+    comp = NoneCompressor()
+    g = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    acc = comp.accumulate(g, comp.init_residual(n))
+    vals, idx, res = comp.compress(acc)
+    np.testing.assert_array_equal(np.asarray(vals), np.asarray(g))
+    np.testing.assert_array_equal(np.asarray(idx), np.arange(n))
+    assert res.shape == (0,)

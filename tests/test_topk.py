@@ -1,0 +1,133 @@
+"""Unit tests for ops.topk against numpy oracles."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gtopkssgd_tpu.ops import (
+    blockwise_topk_abs,
+    k_for_density,
+    membership_mask,
+    merge_sparse_sets,
+    scatter_add_dense,
+    select_topk,
+    topk_abs,
+)
+
+
+def np_topk_abs(x, k):
+    idx = np.argsort(-np.abs(x), kind="stable")[:k]
+    return x[idx], idx
+
+
+def test_k_for_density():
+    assert k_for_density(1000, 0.001) == 1
+    assert k_for_density(1001, 0.001) == 2
+    assert k_for_density(10, 1.0) == 10
+    assert k_for_density(5, 1e-9) == 1
+
+
+@pytest.mark.parametrize("n,k", [(100, 5), (1000, 37), (65536 * 3 + 17, 100)])
+@pytest.mark.parametrize("method", ["exact", "blockwise"])
+def test_topk_matches_oracle(rng, n, k, method):
+    x = rng.standard_normal(n).astype(np.float32)
+    vals, idx = select_topk(jnp.asarray(x), k, method)
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    ov, oi = np_topk_abs(x, k)
+    # Same magnitude multiset (tie order may differ between implementations).
+    np.testing.assert_allclose(
+        np.sort(np.abs(vals)), np.sort(np.abs(ov)), rtol=1e-6
+    )
+    # Selected values really live at the claimed indices.
+    np.testing.assert_array_equal(x[idx], vals)
+    assert len(set(idx.tolist())) == k
+
+
+def test_topk_signed_values(rng):
+    x = rng.standard_normal(256).astype(np.float32)
+    vals, idx = topk_abs(jnp.asarray(x), 16)
+    np.testing.assert_array_equal(np.asarray(vals), x[np.asarray(idx)])
+
+
+def test_blockwise_handles_padding(rng):
+    # n not divisible by block count; top element near the padded tail.
+    n = 1000003
+    x = rng.standard_normal(n).astype(np.float32) * 0.1
+    x[n - 1] = 50.0
+    x[0] = -49.0
+    vals, idx = blockwise_topk_abs(jnp.asarray(x), 4)
+    idx = np.asarray(idx)
+    assert n - 1 in idx and 0 in idx
+    assert np.all(idx < n)
+
+
+def test_merge_sparse_sets_oracle(rng):
+    n = 500
+    for _ in range(10):
+        k = 16
+        ia = rng.choice(n, size=k, replace=False).astype(np.int32)
+        ib = rng.choice(n, size=k, replace=False).astype(np.int32)
+        va = rng.standard_normal(k).astype(np.float32)
+        vb = rng.standard_normal(k).astype(np.float32)
+        mv, mi = merge_sparse_sets(
+            jnp.asarray(va), jnp.asarray(ia), jnp.asarray(vb), jnp.asarray(ib), k, n
+        )
+        dense = np.zeros(n, np.float32)
+        np.add.at(dense, ia, va)
+        np.add.at(dense, ib, vb)
+        got = np.zeros(n, np.float32)
+        np.add.at(got, np.asarray(mi) % (n + 1), np.asarray(mv))
+        got = got[:n] if got.shape[0] == n else got
+        ov, oi = np_topk_abs(dense, k)
+        want = np.zeros(n, np.float32)
+        want[oi] = ov
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_merge_is_order_symmetric(rng):
+    # Both ppermute partners must compute the identical merged set.
+    n, k = 200, 8
+    ia = rng.choice(n, size=k, replace=False).astype(np.int32)
+    ib = rng.choice(n, size=k, replace=False).astype(np.int32)
+    va = rng.standard_normal(k).astype(np.float32)
+    vb = rng.standard_normal(k).astype(np.float32)
+    mv1, mi1 = merge_sparse_sets(
+        jnp.asarray(va), jnp.asarray(ia), jnp.asarray(vb), jnp.asarray(ib), k, n
+    )
+    mv2, mi2 = merge_sparse_sets(
+        jnp.asarray(vb), jnp.asarray(ib), jnp.asarray(va), jnp.asarray(ia), k, n
+    )
+    np.testing.assert_array_equal(np.asarray(mi1), np.asarray(mi2))
+    np.testing.assert_allclose(np.asarray(mv1), np.asarray(mv2), rtol=1e-6)
+
+
+def test_merge_with_sentinel_padding():
+    # Sentinel slots (idx == n, val 0) may repeat; they must never displace
+    # real mass.
+    n, k = 50, 4
+    ia = np.array([1, 2, n, n], np.int32)
+    va = np.array([1.0, -2.0, 0.0, 0.0], np.float32)
+    ib = np.array([2, 3, n, n], np.int32)
+    vb = np.array([5.0, 0.5, 0.0, 0.0], np.float32)
+    mv, mi = merge_sparse_sets(
+        jnp.asarray(va), jnp.asarray(ia), jnp.asarray(vb), jnp.asarray(ib), k, n
+    )
+    dense = np.asarray(scatter_add_dense(n, mi, mv))
+    want = np.zeros(n, np.float32)
+    want[1], want[2], want[3] = 1.0, 3.0, 0.5
+    np.testing.assert_allclose(dense, want, rtol=1e-6)
+
+
+def test_scatter_drops_sentinel():
+    out = scatter_add_dense(
+        4, jnp.array([0, 4, 2], jnp.int32), jnp.array([1.0, 9.0, 2.0])
+    )
+    np.testing.assert_allclose(np.asarray(out), [1.0, 0.0, 2.0, 0.0])
+
+
+def test_membership_mask():
+    q = jnp.array([3, 7, 1, 9], jnp.int32)
+    s = jnp.array([9, 3, 5], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(membership_mask(q, s)), [True, False, False, True]
+    )
