@@ -45,6 +45,13 @@ from gtopkssgd_tpu.ops import merge_sparse_sets, scatter_add_dense, topk_abs
 
 Array = jax.Array
 
+# The reduction-mode vocabulary of the whole package (reference flag
+# --compression / allreducer mode switch). This is the single dispatch
+# table: optimizer.py and the compressor registry both key off these.
+DENSE_MODES = (None, "none", "dense")
+GTOPK_MODES = ("gtopk",)
+ALLGATHER_MODES = ("allgather", "topk", "topkA", "topk_allgather")
+
 
 def _is_pow2(p: int) -> bool:
     return p > 0 and (p & (p - 1)) == 0
@@ -156,12 +163,12 @@ def sparse_allreduce(
     This is the one place the return shape differs across modes; the
     distributed optimizer branches on `gidx is None`.
     """
-    if mode == "gtopk":
+    if mode in GTOPK_MODES:
         gvals, gidx = gtopk_allreduce(
             vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size
         )
         return gvals, gidx, True
-    if mode in ("allgather", "topk", "topkA"):
+    if mode in ALLGATHER_MODES:
         dense = topk_allgather(
             vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size
         )
@@ -174,12 +181,12 @@ def comm_bytes_per_step(mode: str, n: int, k: int, p: int) -> int:
     gtopk O(k log P), allgather O(k P), dense O(N). 8 bytes per (f32, i32)
     element pair; dense counts 4-byte f32 once per element (ring allreduce
     moves ~2N elements, we report the N model like the paper)."""
-    if mode == "gtopk":
+    if mode in GTOPK_MODES:
         if not _is_pow2(p):
             return 8 * k * p
         return 8 * k * max(1, int(math.log2(p)))
-    if mode in ("allgather", "topk", "topkA"):
+    if mode in ALLGATHER_MODES:
         return 8 * k * p
-    if mode in ("dense", "none", None):
+    if mode in DENSE_MODES:
         return 4 * n
     raise ValueError(f"unknown mode {mode!r}")
