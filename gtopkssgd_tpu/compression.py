@@ -30,6 +30,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from gtopkssgd_tpu import modes
 from gtopkssgd_tpu.ops import (
     k_for_density,
     membership_mask,
@@ -122,14 +123,11 @@ class NoneCompressor:
 
 # Name -> class registry, mirroring the reference's module-level
 # `compressors` dict ({'topk': TopKCompressor, 'none'/None: NoneCompressor}).
+# Keys are derived from the package-wide mode vocabulary (modes.py) so the
+# registry can never drift from what the optimizer/collectives accept.
 compressors = {
-    None: NoneCompressor,
-    "none": NoneCompressor,
-    "dense": NoneCompressor,
-    "topk": TopKCompressor,
-    "gtopk": TopKCompressor,
-    "topkA": TopKCompressor,
-    "topk_allgather": TopKCompressor,
+    **{m: NoneCompressor for m in modes.DENSE_MODES},
+    **{m: TopKCompressor for m in modes.SPARSE_MODES},
 }
 
 

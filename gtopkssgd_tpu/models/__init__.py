@@ -1,0 +1,129 @@
+"""Model zoo (reference C7: vgg.py / resnet.py / lstm.py / lstman4.py).
+
+The reference ships one PyTorch nn.Module file per network family and the
+trainer instantiates them by the ``--dnn`` flag string. Here each family is a
+flax.linen module designed TPU-first: NHWC layouts (XLA's native conv layout),
+``dtype`` plumbed through so the whole forward can run in bfloat16 on the MXU
+with float32 params, and recurrent models built on ``lax.scan`` cells instead
+of cuDNN.
+
+``get_model(dnn)`` mirrors the reference's flag-string dispatch; the returned
+``ModelSpec`` also carries the example input shape the trainer/benchmarks use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Tuple
+
+import flax.linen as nn
+
+from gtopkssgd_tpu.models.alexnet import AlexNet
+from gtopkssgd_tpu.models.lstm import PTBLSTM
+from gtopkssgd_tpu.models.lstman4 import DeepSpeechAN4
+from gtopkssgd_tpu.models.resnet import ResNetCIFAR, ResNetImageNet
+from gtopkssgd_tpu.models.vgg import VGG16
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """A zoo entry: constructor, canonical dataset, example input shape
+    (without batch dim), and whether the model is recurrent (the trainer
+    branches for BPTT carry + clip-before-compress)."""
+
+    name: str
+    build: Callable[..., nn.Module]
+    dataset: str
+    example_shape: Tuple[int, ...]
+    recurrent: bool = False
+    has_batchnorm: bool = True
+
+
+_ZOO: Dict[str, ModelSpec] = {}
+
+
+def _register(spec: ModelSpec) -> None:
+    _ZOO[spec.name] = spec
+
+
+_register(ModelSpec("vgg16", VGG16, "cifar10", (32, 32, 3)))
+_register(
+    ModelSpec(
+        "resnet20",
+        lambda **kw: ResNetCIFAR(depth=20, **kw),
+        "cifar10",
+        (32, 32, 3),
+    )
+)
+_register(
+    ModelSpec(
+        "resnet56",
+        lambda **kw: ResNetCIFAR(depth=56, **kw),
+        "cifar10",
+        (32, 32, 3),
+    )
+)
+_register(
+    ModelSpec(
+        "resnet50",
+        ResNetImageNet,
+        "imagenet",
+        (224, 224, 3),
+    )
+)
+_register(
+    ModelSpec(
+        "alexnet",
+        AlexNet,
+        "imagenet",
+        (224, 224, 3),
+        has_batchnorm=False,
+    )
+)
+_register(
+    ModelSpec(
+        "lstm",
+        PTBLSTM,
+        "ptb",
+        (35,),  # BPTT window of token ids
+        recurrent=True,
+        has_batchnorm=False,
+    )
+)
+_register(
+    ModelSpec(
+        "lstman4",
+        DeepSpeechAN4,
+        "an4",
+        (200, 161),  # (time frames, spectrogram bins)
+        recurrent=True,
+    )
+)
+
+
+def get_model(dnn: str, **kwargs: Any) -> Tuple[nn.Module, ModelSpec]:
+    """Build a zoo model by its reference ``--dnn`` flag string."""
+    try:
+        spec = _ZOO[dnn]
+    except KeyError:
+        raise ValueError(
+            f"unknown dnn {dnn!r}; available: {sorted(_ZOO)}"
+        ) from None
+    return spec.build(**kwargs), spec
+
+
+def available_models():
+    return sorted(_ZOO)
+
+
+__all__ = [
+    "get_model",
+    "available_models",
+    "ModelSpec",
+    "VGG16",
+    "ResNetCIFAR",
+    "ResNetImageNet",
+    "AlexNet",
+    "PTBLSTM",
+    "DeepSpeechAN4",
+]

@@ -1,0 +1,45 @@
+"""AlexNet for ImageNet (reference C7 — one of the two ImageNet workloads;
+the reference likely pulled it from torchvision, SURVEY.md C7 [M]).
+
+Standard single-tower AlexNet (the torchvision variant): five conv layers,
+three max pools, 4096-4096-classes classifier with dropout. No BatchNorm —
+exactly why the paper uses it as the "huge flat gradient" stress case
+(~61M params, dominated by the first FC layer's 38M).
+
+TPU notes: NHWC, compute dtype plumbed for bfloat16; the big FC layers are
+pure MXU matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = lambda f, k, s=1, p=0: nn.Conv(
+            f, (k, k), strides=s, padding=p, dtype=self.dtype
+        )
+        x = nn.relu(conv(64, 11, 4, 2)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(192, 5, 1, 2)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(384, 3, 1, 1)(x))
+        x = nn.relu(conv(256, 3, 1, 1)(x))
+        x = nn.relu(conv(256, 3, 1, 1)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))  # (B, 256*6*6)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

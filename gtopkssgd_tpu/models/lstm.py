@@ -1,0 +1,62 @@
+"""2-layer LSTM language model for PTB (reference C7: the PTB LSTM workload).
+
+The reference's PTB model is the classic Zaremba et al. "medium" LM the
+paper's LSTM-PTB workload uses: embedding -> 2x LSTM -> tied-size softmax,
+trained with BPTT over fixed windows, hidden state carried (and detached)
+across windows, gradient-norm clipping BEFORE compression (SURVEY.md §3.4).
+
+TPU-native: the recurrence is a ``flax.linen.RNN`` over
+``OptimizedLSTMCell`` — an ``lax.scan`` whose per-step matmuls XLA fuses
+onto the MXU, replacing cuDNN. The carry is an explicit pytree the trainer
+threads through the jitted step (functional BPTT; "detach" is free because
+the carry re-enters as a fresh traced input each window).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Carry = Tuple  # ((c, h) per layer)
+
+
+class PTBLSTM(nn.Module):
+    vocab_size: int = 10000
+    hidden_size: int = 650
+    num_layers: int = 2
+    dropout_rate: float = 0.5
+    dtype: Any = jnp.float32
+
+    def initial_carry(self, batch_size: int) -> Carry:
+        zeros = lambda: (
+            jnp.zeros((batch_size, self.hidden_size), self.dtype),
+            jnp.zeros((batch_size, self.hidden_size), self.dtype),
+        )
+        return tuple(zeros() for _ in range(self.num_layers))
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens,  # i32[B, T]
+        carry: Optional[Carry] = None,
+        *,
+        train: bool = False,
+    ):
+        """Returns (logits f32[B, T, vocab], final_carry)."""
+        if carry is None:
+            carry = self.initial_carry(tokens.shape[0])
+        x = nn.Embed(self.vocab_size, self.hidden_size, dtype=self.dtype)(tokens)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        new_carry = []
+        for layer in range(self.num_layers):
+            rnn = nn.RNN(
+                nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype),
+                return_carry=True,
+            )
+            c, x = rnn(x, initial_carry=carry[layer])
+            new_carry.append(c)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype)(x)
+        return logits.astype(jnp.float32), tuple(new_carry)

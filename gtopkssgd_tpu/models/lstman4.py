@@ -1,0 +1,80 @@
+"""Bidirectional LSTM + CTC speech model for AN4 (reference C7: lstman4.py,
+deepspeech.pytorch lineage — SequenceWise batchnorm + BatchRNN stacks).
+
+Architecture (DeepSpeech-2 style, sized down for AN4's ~1h of audio):
+a 2-layer strided conv front-end over the (time, freq) spectrogram, a stack
+of bidirectional LSTM layers with sequence-wise BatchNorm between them, and
+a per-frame linear head over the character vocabulary, trained with CTC
+(the reference needed the native warp-ctc CUDA lib for this; here the loss
+is `optax.ctc_loss`, pure XLA — see gtopkssgd_tpu.trainer).
+
+TPU-native: the BiLSTM is two `lax.scan` directions (`flax.linen.Bidirectional`),
+convs NHWC in the compute dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Default char vocabulary size: blank + ' + A..Z + space + padding slots,
+# matching deepspeech-style English char models (29 labels incl. blank at 0).
+AN4_NUM_CHARS = 29
+
+
+class SequenceWiseBatchNorm(nn.Module):
+    """BatchNorm over the collapsed (batch*time) dim — the reference model's
+    `SequenceWise(nn.BatchNorm1d)` trick, which normalizes per-feature over
+    every frame in the batch."""
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):  # x: [B, T, F]
+        b, t, f = x.shape
+        y = x.reshape(b * t, f)
+        y = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(y)
+        return y.reshape(b, t, f)
+
+
+class DeepSpeechAN4(nn.Module):
+    num_chars: int = AN4_NUM_CHARS
+    rnn_hidden: int = 512
+    rnn_layers: int = 4
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        """x: f32[B, T, F] log-spectrograms. Returns per-frame logits
+        f32[B, T', num_chars] with T' = T/4 (two stride-2 convs in time)."""
+        b = x.shape[0]
+        y = x[..., None]  # [B, T, F, 1]
+        y = nn.Conv(32, (11, 41), strides=(2, 2), padding=((5, 5), (20, 20)),
+                    use_bias=False, dtype=self.dtype)(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(y)
+        y = nn.hard_tanh(y)
+        y = nn.Conv(32, (11, 21), strides=(2, 2), padding=((5, 5), (10, 10)),
+                    use_bias=False, dtype=self.dtype)(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(y)
+        y = nn.hard_tanh(y)
+        # [B, T', F', 32] -> [B, T', F'*32]
+        y = y.reshape(b, y.shape[1], -1)
+        for layer in range(self.rnn_layers):
+            if layer > 0:
+                y = SequenceWiseBatchNorm()(y, train=train)
+            bi = nn.Bidirectional(
+                nn.RNN(nn.OptimizedLSTMCell(self.rnn_hidden, dtype=self.dtype)),
+                nn.RNN(nn.OptimizedLSTMCell(self.rnn_hidden, dtype=self.dtype)),
+                merge_fn=lambda a, b: a + b,  # sum-merge keeps width constant
+            )
+            y = bi(y)
+        y = SequenceWiseBatchNorm()(y, train=train)
+        logits = nn.Dense(self.num_chars, dtype=self.dtype)(y)
+        return logits.astype(jnp.float32)
+
+    @staticmethod
+    def output_length(input_length):
+        """Frame count after the two stride-2 convs (for CTC input lengths).
+        Each conv: out = (in + 2*pad - kernel)//stride + 1 with pad=5, k=11."""
+        t1 = (input_length - 1) // 2 + 1
+        return (t1 - 1) // 2 + 1

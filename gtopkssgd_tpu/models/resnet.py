@@ -1,0 +1,112 @@
+"""ResNets: CIFAR ResNet-20/56 and ImageNet ResNet-50 (reference C7:
+resnet.py — the reference trains ResNet-20/CIFAR-10 and ResNet-50/ImageNet).
+
+Two families, faithful to the original papers the reference used:
+
+  * ``ResNetCIFAR`` — He et al.'s CIFAR design: 3x3 stem, three stages of
+    basic blocks at widths 16/32/64, depth = 6n+2 (n=3 -> ResNet-20,
+    n=9 -> ResNet-56), global average pool, linear head.
+  * ``ResNetImageNet`` — the bottleneck design: 7x7/2 stem + 3x3/2 max pool,
+    stages [3,4,6,3] at widths 256/512/1024/2048 for ResNet-50.
+
+TPU notes: NHWC, compute in ``dtype`` (bfloat16 on the MXU), BatchNorm in
+float32. Projection (option-B) shortcuts on shape change.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, dtype=jnp.float32
+        )
+        y = conv(self.filters, (3, 3), strides=self.strides, padding=1)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), padding=1)(y)
+        y = norm()(y)
+        if x.shape[-1] != self.filters or self.strides != 1:
+            x = conv(self.filters, (1, 1), strides=self.strides)(x)
+            x = norm()(x)
+        return nn.relu(x + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int  # output width (4x the inner width)
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, dtype=jnp.float32
+        )
+        inner = self.filters // 4
+        y = conv(inner, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(inner, (3, 3), strides=self.strides, padding=1)(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN
+        if x.shape[-1] != self.filters or self.strides != 1:
+            x = conv(self.filters, (1, 1), strides=self.strides)(x)
+            x = norm()(x)
+        return nn.relu(x + y)
+
+
+class ResNetCIFAR(nn.Module):
+    depth: int = 20  # 6n+2
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        if (self.depth - 2) % 6 != 0:
+            raise ValueError("CIFAR ResNet depth must be 6n+2")
+        n = (self.depth - 2) // 6
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        for stage, width in enumerate((16, 32, 64)):
+            for block in range(n):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(width, strides, self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class ResNetImageNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, blocks in enumerate(self.stage_sizes):
+            width = 256 * (2 ** stage)
+            for block in range(blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(width, strides, self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

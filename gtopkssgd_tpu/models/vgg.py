@@ -1,0 +1,51 @@
+"""VGG-16 for CIFAR-10 (reference C7: vgg.py, the CIFAR VGG variant).
+
+The reference trains VGG-16 on CIFAR-10 as one of its two CIFAR workloads
+(paper §experiments). This is the standard CIFAR adaptation of configuration
+D: 13 conv layers with BatchNorm+ReLU, five 2x2 max-pools down to 1x1x512,
+and a compact classifier head (512 -> 512 -> classes) instead of the
+4096-wide ImageNet head.
+
+TPU notes: NHWC layout, 3x3 convs in ``dtype`` (bfloat16-ready for the MXU),
+BatchNorm statistics kept in float32 regardless of compute dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Configuration D feature stack; 'M' = 2x2 max pool.
+_CFG_D: Sequence[Union[int, str]] = (
+    64, 64, "M",
+    128, 128, "M",
+    256, 256, 256, "M",
+    512, 512, 512, "M",
+    512, 512, 512, "M",
+)
+
+
+class VGG16(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        for v in _CFG_D:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding=1, use_bias=False,
+                            dtype=self.dtype)(x)
+                x = nn.BatchNorm(use_running_average=not train,
+                                 dtype=jnp.float32)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))  # (B, 512) after five pools on 32x32
+        x = nn.Dense(512, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,13 @@
+"""The package's single reduction-mode vocabulary (reference --compression
+flag / allreducer mode switch). Every dispatch table — the compressor
+registry, the sparse-allreduce dispatch, the optimizer, and the comm-volume
+model — keys off these tuples so a new mode string cannot be added to one
+table and silently missed by another.
+"""
+
+DENSE_MODES = (None, "none", "dense")
+GTOPK_MODES = ("gtopk",)
+ALLGATHER_MODES = ("allgather", "topk", "topkA", "topk_allgather")
+
+ALL_MODES = DENSE_MODES + GTOPK_MODES + ALLGATHER_MODES
+SPARSE_MODES = GTOPK_MODES + ALLGATHER_MODES

@@ -43,13 +43,9 @@ from jax import lax
 from jax.flatten_util import ravel_pytree
 
 from gtopkssgd_tpu.compression import get_compressor
+from gtopkssgd_tpu.modes import ALL_MODES, DENSE_MODES
 from gtopkssgd_tpu.ops import scatter_add_dense
 from gtopkssgd_tpu.parallel import sparse_allreduce
-from gtopkssgd_tpu.parallel.collectives import (
-    ALLGATHER_MODES,
-    DENSE_MODES,
-    GTOPK_MODES,
-)
 
 Array = jax.Array
 ScalarOrSchedule = Union[float, Callable[[Array], Array]]
@@ -100,12 +96,14 @@ def gtopk_sgd(
     validated against it.
     """
     mode = compression
-    if mode not in DENSE_MODES + GTOPK_MODES + ALLGATHER_MODES:
+    if mode not in ALL_MODES:
         raise ValueError(f"unknown compression mode {mode!r}")
+    if nesterov and not momentum:
+        # torch.optim.SGD raises here too; silently running plain SGD while
+        # the user believes Nesterov is active would be worse.
+        raise ValueError("nesterov momentum requires momentum > 0")
     dense_mode = mode in DENSE_MODES
-    compressor = get_compressor(
-        None if dense_mode else "topk", density=density, method=topk_method
-    )
+    compressor = get_compressor(mode, density=density, method=topk_method)
     inner = optax.chain(
         optax.add_decayed_weights(weight_decay) if weight_decay else optax.identity(),
         optax.sgd(learning_rate, momentum=momentum or None, nesterov=nesterov),
@@ -119,6 +117,15 @@ def gtopk_sgd(
         try:
             p = lax.axis_size(axis_name)
         except NameError:  # not inside shard_map over axis_name
+            if axis_size is not None and axis_size > 1:
+                # The caller explicitly expects a multi-device run; falling
+                # back to p=1 would silently skip every collective and let
+                # replicas drift. Fail loudly instead.
+                raise ValueError(
+                    f"axis_size={axis_size} was given but mesh axis "
+                    f"{axis_name!r} is not bound — is update() running "
+                    "inside jax.shard_map over that axis?"
+                ) from None
             return 1
         if axis_size is not None and axis_size != p:
             raise ValueError(

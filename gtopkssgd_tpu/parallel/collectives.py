@@ -45,12 +45,13 @@ from gtopkssgd_tpu.ops import merge_sparse_sets, scatter_add_dense, topk_abs
 
 Array = jax.Array
 
-# The reduction-mode vocabulary of the whole package (reference flag
-# --compression / allreducer mode switch). This is the single dispatch
-# table: optimizer.py and the compressor registry both key off these.
-DENSE_MODES = (None, "none", "dense")
-GTOPK_MODES = ("gtopk",)
-ALLGATHER_MODES = ("allgather", "topk", "topkA", "topk_allgather")
+# Re-exported for callers that reach collectives directly; the canonical
+# definition lives in gtopkssgd_tpu.modes (single vocabulary, no drift).
+from gtopkssgd_tpu.modes import (  # noqa: E402  (re-export)
+    ALLGATHER_MODES,
+    DENSE_MODES,
+    GTOPK_MODES,
+)
 
 
 def _is_pow2(p: int) -> bool:

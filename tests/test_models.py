@@ -1,0 +1,113 @@
+"""Model zoo: forward shapes, param counts, and train/eval mode plumbing.
+
+Param-count pins are the strongest cheap parity check against the reference's
+PyTorch models (SURVEY.md C7): matching counts means matching architecture.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gtopkssgd_tpu.models import available_models, get_model
+
+
+def n_params(variables):
+    return sum(x.size for x in jax.tree.leaves(variables["params"]))
+
+
+def init_and_apply(model, spec, batch=2, **apply_kw):
+    rng = jax.random.PRNGKey(0)
+    if spec.name == "lstm":
+        x = jnp.zeros((batch,) + tuple(spec.example_shape), jnp.int32)
+    else:
+        x = jnp.zeros((batch,) + tuple(spec.example_shape), jnp.float32)
+    variables = model.init({"params": rng, "dropout": rng}, x)
+    out = model.apply(variables, x, **apply_kw)
+    return variables, out
+
+
+def test_registry_lists_reference_workloads():
+    # The six paper workloads' model families must all be buildable.
+    assert {"vgg16", "resnet20", "resnet50", "alexnet", "lstm", "lstman4"} <= set(
+        available_models()
+    )
+    with pytest.raises(ValueError):
+        get_model("not-a-model")
+
+
+@pytest.mark.parametrize(
+    "name,expected_params,tol",
+    [
+        ("resnet20", 272_474, 0.02),   # He et al. CIFAR ResNet-20 ~0.27M
+        ("resnet56", 855_770, 0.02),   # ~0.85M
+        ("vgg16", 15_000_000, 0.07),   # CIFAR VGG-16+BN ~14.7-15.3M
+        ("alexnet", 61_100_840, 0.001),  # torchvision AlexNet exactly
+        ("resnet50", 25_557_032, 0.02),  # ~25.5M
+    ],
+)
+def test_vision_param_counts(name, expected_params, tol):
+    model, spec = get_model(name)
+    variables, out = init_and_apply(model, spec, batch=1)
+    got = n_params(variables)
+    assert abs(got - expected_params) / expected_params <= tol, got
+    classes = 10 if spec.dataset == "cifar10" else 1000
+    assert out.shape == (1, classes)
+    assert out.dtype == jnp.float32
+
+
+@pytest.mark.parametrize("name", ["vgg16", "resnet20"])
+def test_train_mode_updates_batch_stats(name):
+    model, spec = get_model(name)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (4,) + tuple(spec.example_shape))
+    variables = model.init({"params": rng, "dropout": rng}, x)
+    out, mutated = model.apply(
+        variables, x, train=True,
+        rngs={"dropout": rng}, mutable=["batch_stats"],
+    )
+    # running stats must actually move in train mode
+    before = jax.tree.leaves(variables["batch_stats"])
+    after = jax.tree.leaves(mutated["batch_stats"])
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b)) for a, b in zip(before, after)
+    )
+
+
+def test_ptb_lstm_shapes_and_carry():
+    model, spec = get_model("lstm")
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (3, 35), 0, 10000)
+    variables = model.init({"params": rng}, tokens)
+    (logits, carry), _ = model.apply(variables, tokens, mutable=[])
+    assert logits.shape == (3, 35, 10000)
+    assert len(carry) == 2 and len(carry[0]) == 2
+    # carry threads across windows: different carry -> different logits
+    logits2, carry2 = model.apply(variables, tokens, carry)
+    assert not np.allclose(np.asarray(logits), np.asarray(logits2))
+    # Zaremba "medium" ~ 19.8M params
+    got = n_params(variables)
+    assert abs(got - 19_800_000) / 19_800_000 < 0.05, got
+
+
+def test_an4_shapes_and_output_length():
+    model, spec = get_model("lstman4")
+    rng = jax.random.PRNGKey(0)
+    for t in (100, 101, 57):
+        x = jax.random.normal(rng, (2, t, 161))
+        variables = model.init({"params": rng}, x)
+        logits = model.apply(variables, x)
+        assert logits.shape[0] == 2 and logits.shape[2] == 29
+        assert logits.shape[1] == model.output_length(t), (
+            t, logits.shape, model.output_length(t)
+        )
+
+
+def test_bfloat16_forward():
+    model, spec = get_model("resnet20", dtype=jnp.bfloat16)
+    variables, out = init_and_apply(model, spec, batch=2)
+    # params stay f32, output cast back to f32
+    assert all(
+        v.dtype == jnp.float32 for v in jax.tree.leaves(variables["params"])
+    )
+    assert out.dtype == jnp.float32
