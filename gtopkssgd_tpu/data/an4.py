@@ -1,0 +1,151 @@
+"""AN4 speech pipeline (reference C8: the deepspeech.pytorch-style audio
+dataset + manifest files the reference's lstman4 workload consumes).
+
+Real path: a manifest CSV of ``wav_path,transcript_path`` lines (the
+deepspeech manifest format the reference used); wavs are read with
+scipy.io.wavfile, converted to log-STFT spectrograms (20ms window, 10ms
+hop, 161 bins at 16kHz), transcripts mapped over the 29-char vocabulary.
+
+Synthetic fallback: random utterances whose spectrogram is correlated with
+a random character sequence so CTC training has signal.
+
+Batches are padded to the longest utterance in the batch, with
+``input_lengths`` (pre-conv frame counts) and ``label_lengths`` for CTC —
+shapes rebucketed to multiples of 16 frames to bound XLA recompiles.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+from gtopkssgd_tpu.data.partition import DataPartitioner
+from gtopkssgd_tpu.data.partition import split_id as _split_id
+
+# Blank at 0, then apostrophe, A-Z, space — the deepspeech English labels.
+LABELS = "_'ABCDEFGHIJKLMNOPQRSTUVWXYZ "
+CHAR_TO_ID = {c: i for i, c in enumerate(LABELS)}
+N_BINS = 161
+SYNTH_TRAIN, SYNTH_TEST = 256, 64
+
+
+def text_to_ids(text: str) -> np.ndarray:
+    return np.asarray(
+        [CHAR_TO_ID[c] for c in text.upper() if c in CHAR_TO_ID], np.int32
+    )
+
+
+def wav_to_logspec(path: str) -> np.ndarray:
+    import scipy.io.wavfile as wavfile
+    import scipy.signal as sig
+
+    sr, audio = wavfile.read(path)
+    audio = audio.astype(np.float32) / 32768.0
+    nperseg = int(0.02 * sr)
+    noverlap = nperseg - int(0.01 * sr)
+    _, _, spec = sig.stft(audio, sr, nperseg=nperseg, noverlap=noverlap,
+                          nfft=320)
+    return np.log1p(np.abs(spec.T)).astype(np.float32)  # [T, 161]
+
+
+@functools.lru_cache(maxsize=4)
+def _synth_utterances(split: str, seed: int, num_chars: int) -> List[Dict]:
+    """Synthetic utterances whose spectrogram correlates with the transcript
+    (per-char spectral signatures), cached so P rank objects share one list
+    and seeded stably across processes (crc32, not hash())."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, _split_id(split)]))
+    n = SYNTH_TRAIN if split == "train" else SYNTH_TEST
+    signatures = rng.standard_normal((num_chars, N_BINS)).astype(np.float32)
+    utts: List[Dict] = []
+    for _ in range(n):
+        L = int(rng.integers(4, 12))
+        labels = rng.integers(1, num_chars, L).astype(np.int32)
+        frames_per = int(rng.integers(6, 12))
+        T = L * frames_per
+        spec = 0.1 * rng.standard_normal((T, N_BINS)).astype(np.float32)
+        for j, ch in enumerate(labels):
+            spec[j * frames_per:(j + 1) * frames_per] += 0.5 * signatures[ch]
+        utts.append({"spec": spec, "labels": labels})
+    return utts
+
+
+class AN4Dataset:
+    num_chars = len(LABELS)
+
+    def __init__(self, *, split="train", batch_size=8, rank=0, nworkers=1,
+                 data_dir=None, seed=0, max_frames=400, max_label_len=64):
+        self.split = split
+        self.batch_size = batch_size
+        self.max_frames = max_frames
+        self.max_label_len = max_label_len
+        manifest = os.path.join(
+            data_dir or "", f"an4_{'train' if split == 'train' else 'val'}_manifest.csv"
+        )
+        self.synthetic = not os.path.isfile(manifest)
+        if self.synthetic:
+            self._utts = _synth_utterances(split, seed, self.num_chars)
+            count = len(self._utts)
+        else:
+            self._manifest = [
+                line.strip().split(",")
+                for line in open(manifest)
+                if line.strip()
+            ]
+            self._utts = None
+            count = len(self._manifest)
+        self.partitioner = DataPartitioner(count, rank, nworkers, seed)
+        if len(self.partitioner) < batch_size:
+            raise ValueError(
+                f"rank shard has {len(self.partitioner)} utterances < "
+                f"batch_size {batch_size} — lower batch_size or nworkers"
+            )
+
+    def steps_per_epoch(self) -> int:
+        return len(self.partitioner) // self.batch_size
+
+    def _load(self, i: int) -> Dict:
+        if self.synthetic:
+            return self._utts[i]
+        wav, txt = self._manifest[i][:2]
+        return {
+            "spec": wav_to_logspec(wav),
+            "labels": text_to_ids(open(txt).read().strip()),
+        }
+
+    def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        idx = self.partitioner.indices(epoch)
+        b = self.batch_size
+        for lo in range(0, len(idx) - b + 1, b):
+            utts = [self._load(i) for i in idx[lo:lo + b]]
+            t_max = min(
+                self.max_frames,
+                -(-max(u["spec"].shape[0] for u in utts) // 16) * 16,
+            )
+            l_max = min(
+                self.max_label_len, max(len(u["labels"]) for u in utts)
+            )
+            spec = np.zeros((b, t_max, N_BINS), np.float32)
+            labels = np.zeros((b, l_max), np.int32)
+            in_len = np.zeros((b,), np.int32)
+            lab_len = np.zeros((b,), np.int32)
+            for j, u in enumerate(utts):
+                t = min(u["spec"].shape[0], t_max)
+                l = min(len(u["labels"]), l_max)
+                spec[j, :t] = u["spec"][:t]
+                labels[j, :l] = u["labels"][:l]
+                in_len[j], lab_len[j] = t, l
+            yield {
+                "spectrogram": spec,
+                "labels": labels,
+                "input_lengths": in_len,
+                "label_lengths": lab_len,
+            }
+
+    def __iter__(self):
+        e = 0
+        while True:
+            yield from self.epoch(e)
+            e += 1

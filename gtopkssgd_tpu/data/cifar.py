@@ -1,0 +1,125 @@
+"""CIFAR-10 pipeline (reference C8: torchvision CIFAR-10 loaders with
+random-crop + flip augmentation inside dl_trainer.py).
+
+Reads the standard python-pickle batches (``cifar-10-batches-py``) from
+``data_dir`` when present; otherwise generates a deterministic synthetic
+stand-in with identical shapes/dtypes and a learnable class signal (class
+mean offsets), so smoke training shows a falling loss without any download.
+
+Augmentation matches the reference recipe: 4-pixel reflection pad + random
+32x32 crop + horizontal flip, then per-channel mean/std normalization. All
+host-side numpy; batches are NHWC float32.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Dict, Iterator
+
+import numpy as np
+
+from gtopkssgd_tpu.data.partition import DataPartitioner
+from gtopkssgd_tpu.data.partition import split_id as _split_id
+
+CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+SYNTH_TRAIN, SYNTH_TEST = 2048, 512
+
+
+@functools.lru_cache(maxsize=4)
+def _load_real(data_dir: str, split: str):
+    root = os.path.join(data_dir, "cifar-10-batches-py")
+    files = (
+        [f"data_batch_{i}" for i in range(1, 6)]
+        if split == "train"
+        else ["test_batch"]
+    )
+    images, labels = [], []
+    for f in files:
+        with open(os.path.join(root, f), "rb") as fh:
+            d = pickle.load(fh, encoding="bytes")
+        images.append(
+            d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        )
+        labels.append(np.asarray(d[b"labels"], np.int32))
+    return (
+        np.concatenate(images).astype(np.float32) / 255.0,
+        np.concatenate(labels),
+    )
+
+
+@functools.lru_cache(maxsize=4)
+def _synthetic(split: str, seed: int):
+    """Class-conditional Gaussian images: separable, so loss curves mean
+    something even without real data. Cached so the P per-rank dataset
+    objects in one SPMD process share one array, not P copies."""
+    n = SYNTH_TRAIN if split == "train" else SYNTH_TEST
+    rng = np.random.default_rng(np.random.SeedSequence([seed, _split_id(split)]))
+    labels = rng.integers(0, 10, n).astype(np.int32)
+    offsets = rng.standard_normal((10, 3)).astype(np.float32) * 0.25
+    images = 0.5 + 0.15 * rng.standard_normal((n, 32, 32, 3)).astype(np.float32)
+    images += offsets[labels][:, None, None, :]
+    return np.clip(images, 0.0, 1.0), labels
+
+
+class CIFAR10Dataset:
+    num_classes = 10
+    example_shape = (32, 32, 3)
+
+    def __init__(self, *, split="train", batch_size=32, rank=0, nworkers=1,
+                 data_dir=None, seed=0, augment=None):
+        self.split = split
+        self.batch_size = batch_size
+        self.augment = (split == "train") if augment is None else augment
+        root = data_dir or ""
+        self.synthetic = not os.path.isdir(
+            os.path.join(root, "cifar-10-batches-py")
+        )
+        if self.synthetic:
+            self.images, self.labels = _synthetic(split, seed)
+        else:
+            self.images, self.labels = _load_real(root, split)
+        self.partitioner = DataPartitioner(
+            len(self.images), rank, nworkers, seed
+        )
+        if len(self.partitioner) < batch_size:
+            raise ValueError(
+                f"rank shard has {len(self.partitioner)} samples < "
+                f"batch_size {batch_size} — lower batch_size or nworkers"
+            )
+        self._rng = np.random.default_rng(np.random.SeedSequence([seed, rank + 1]))
+
+    def steps_per_epoch(self) -> int:
+        return len(self.partitioner) // self.batch_size
+
+    def _augment(self, x: np.ndarray) -> np.ndarray:
+        b = x.shape[0]
+        padded = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
+        out = np.empty_like(x)
+        ys = self._rng.integers(0, 9, b)
+        xs = self._rng.integers(0, 9, b)
+        flip = self._rng.random(b) < 0.5
+        for i in range(b):
+            crop = padded[i, ys[i]:ys[i] + 32, xs[i]:xs[i] + 32]
+            out[i] = crop[:, ::-1] if flip[i] else crop
+        return out
+
+    def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        """One pass over this rank's shard, in the shared per-epoch order."""
+        idx = self.partitioner.indices(epoch)
+        for lo in range(0, len(idx) - self.batch_size + 1, self.batch_size):
+            sel = idx[lo:lo + self.batch_size]
+            x = self.images[sel]
+            if self.augment:
+                x = self._augment(x)
+            x = (x - CIFAR_MEAN) / CIFAR_STD
+            yield {"image": x.astype(np.float32), "label": self.labels[sel]}
+
+    def __iter__(self):
+        """Endless stream across epochs (what the training loop consumes)."""
+        e = 0
+        while True:
+            yield from self.epoch(e)
+            e += 1

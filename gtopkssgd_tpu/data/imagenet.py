@@ -1,0 +1,151 @@
+"""ImageNet pipeline (reference C8: ``ImageFolder`` over the standard
+train/val directory layout inside dl_trainer.py).
+
+Real path: ``data_dir/{train,val}/<wnid>/*.JPEG`` decoded with PIL,
+random-resized-crop(224) + flip for train, resize(256)+center-crop(224) for
+eval, ImageNet mean/std normalization — the reference's torchvision recipe
+re-implemented host-side in numpy/PIL.
+
+Synthetic fallback generates class-conditional noise at full 224x224 so the
+ResNet-50/AlexNet benchmark path runs with the true compute shape in a
+zero-egress environment.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, Iterator, List, Tuple
+
+import numpy as np
+
+from gtopkssgd_tpu.data.partition import DataPartitioner
+from gtopkssgd_tpu.data.partition import split_id as _split_id
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+SYNTH_TRAIN, SYNTH_TEST = 1024, 256
+
+
+@functools.lru_cache(maxsize=4)
+def _index_folder(root: str) -> Tuple[List[str], np.ndarray, List[str]]:
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+    )
+    paths, labels = [], []
+    for ci, c in enumerate(classes):
+        cdir = os.path.join(root, c)
+        for f in sorted(os.listdir(cdir)):
+            if f.lower().endswith((".jpeg", ".jpg", ".png")):
+                paths.append(os.path.join(cdir, f))
+                labels.append(ci)
+    return paths, np.asarray(labels, np.int32), classes
+
+
+class ImageNetDataset:
+    example_shape = (224, 224, 3)
+
+    def __init__(self, *, split="train", batch_size=32, rank=0, nworkers=1,
+                 data_dir=None, seed=0, image_size=224, num_classes=1000):
+        self.split = split
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.train = split == "train"
+        subdir = "train" if self.train else "val"
+        root = os.path.join(data_dir or "", subdir)
+        self.synthetic = not os.path.isdir(root)
+        self._seed = seed
+        if self.synthetic:
+            self.num_classes = num_classes
+            n = SYNTH_TRAIN if self.train else SYNTH_TEST
+            rng = np.random.default_rng(
+                np.random.SeedSequence([seed, _split_id(split)])
+            )
+            self._labels = rng.integers(0, num_classes, n).astype(np.int32)
+            self._offsets = (
+                rng.standard_normal((num_classes, 3)).astype(np.float32) * 0.25
+            )
+            self._paths = None
+            count = n
+        else:
+            self._paths, self._labels, classes = _index_folder(root)
+            self.num_classes = len(classes)
+            count = len(self._paths)
+        self.partitioner = DataPartitioner(count, rank, nworkers, seed)
+        if len(self.partitioner) < batch_size:
+            raise ValueError(
+                f"rank shard has {len(self.partitioner)} samples < "
+                f"batch_size {batch_size} — lower batch_size or nworkers"
+            )
+        self._rng = np.random.default_rng(np.random.SeedSequence([seed, rank + 1]))
+
+    def steps_per_epoch(self) -> int:
+        return len(self.partitioner) // self.batch_size
+
+    # --- real-image decode path -------------------------------------------
+    def _decode(self, path: str) -> np.ndarray:
+        from PIL import Image
+
+        s = self.image_size
+        with Image.open(path) as im:
+            im = im.convert("RGB")
+            if self.train:
+                # random resized crop: area 8%-100%, aspect 3/4..4/3
+                w, h = im.size
+                for _ in range(10):
+                    area = w * h * self._rng.uniform(0.08, 1.0)
+                    ar = np.exp(self._rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+                    cw, ch = int(round(np.sqrt(area * ar))), int(
+                        round(np.sqrt(area / ar))
+                    )
+                    if cw <= w and ch <= h:
+                        x0 = self._rng.integers(0, w - cw + 1)
+                        y0 = self._rng.integers(0, h - ch + 1)
+                        im = im.resize((s, s), box=(x0, y0, x0 + cw, y0 + ch))
+                        break
+                else:
+                    im = im.resize((s, s))
+                arr = np.asarray(im, np.float32) / 255.0
+                if self._rng.random() < 0.5:
+                    arr = arr[:, ::-1]
+            else:
+                w, h = im.size
+                scale = 256 / min(w, h)
+                im = im.resize((int(w * scale), int(h * scale)))
+                w, h = im.size
+                x0, y0 = (w - s) // 2, (h - s) // 2
+                arr = (
+                    np.asarray(im, np.float32)[y0:y0 + s, x0:x0 + s] / 255.0
+                )
+        return arr
+
+    def _synth_batch(self, sel: np.ndarray) -> np.ndarray:
+        """Deterministic per-index generation: sample i is the same array on
+        every pass and in every process, so eval metrics are comparable
+        across epochs/runs without holding n*224*224*3 floats resident."""
+        s = self.image_size
+        out = np.empty((len(sel), s, s, 3), np.float32)
+        for j, i in enumerate(sel):
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self._seed, _split_id(self.split), int(i)])
+            )
+            out[j] = 0.5 + 0.15 * rng.standard_normal((s, s, 3))
+        out += self._offsets[self._labels[sel]][:, None, None, :]
+        return np.clip(out, 0.0, 1.0)
+
+    def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        idx = self.partitioner.indices(epoch)
+        for lo in range(0, len(idx) - self.batch_size + 1, self.batch_size):
+            sel = idx[lo:lo + self.batch_size]
+            if self.synthetic:
+                x = self._synth_batch(sel)
+            else:
+                x = np.stack([self._decode(self._paths[i]) for i in sel])
+            x = (x - IMAGENET_MEAN) / IMAGENET_STD
+            yield {"image": x.astype(np.float32), "label": self._labels[sel]}
+
+    def __iter__(self):
+        e = 0
+        while True:
+            yield from self.epoch(e)
+            e += 1

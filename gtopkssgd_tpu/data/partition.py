@@ -1,0 +1,64 @@
+"""Deterministic per-rank data sharding (reference C1: the
+``Partition``/``DataPartitioner``-style rank sharding inside dl_trainer.py).
+
+The reference partitions the training set into P disjoint slices, one per
+MPI rank, shuffled with a shared seed so every rank computes the same
+permutation without communicating. Same contract here; the per-epoch
+reshuffle folds the epoch index into the seed (the reference reshuffled via
+its sampler each epoch).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+
+def split_id(split: str) -> int:
+    """Stable integer id for a split name, for RNG seeding. Python's
+    ``hash()`` is randomized per process (PYTHONHASHSEED), which would give
+    every host of a multi-host run a *different* synthetic dataset; crc32
+    is stable across processes and runs."""
+    return zlib.crc32(split.encode())
+
+
+def partition_indices(
+    n: int, rank: int, nworkers: int, seed: int = 0, epoch: int = 0
+) -> np.ndarray:
+    """This rank's disjoint slice of a shared permutation of range(n).
+
+    All ranks calling with the same (n, nworkers, seed, epoch) derive the
+    same permutation; slices are contiguous blocks of it, so they are
+    disjoint and cover the set (the last worker absorbs the remainder).
+    """
+    if not 0 <= rank < nworkers:
+        raise ValueError(f"rank {rank} out of range for {nworkers} workers")
+    rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+    perm = rng.permutation(n)
+    per = n // nworkers
+    lo = rank * per
+    hi = (rank + 1) * per if rank < nworkers - 1 else n
+    return perm[lo:hi]
+
+
+class DataPartitioner:
+    """Object form used by the trainer: holds (n, rank, nworkers, seed) and
+    hands out the per-epoch index slice."""
+
+    def __init__(self, n: int, rank: int = 0, nworkers: int = 1, seed: int = 0):
+        self.n = n
+        self.rank = rank
+        self.nworkers = nworkers
+        self.seed = seed
+
+    def indices(self, epoch: int = 0) -> np.ndarray:
+        return partition_indices(
+            self.n, self.rank, self.nworkers, self.seed, epoch
+        )
+
+    def __len__(self) -> int:
+        per = self.n // self.nworkers
+        return per if self.rank < self.nworkers - 1 else self.n - per * (
+            self.nworkers - 1
+        )

@@ -1,0 +1,105 @@
+"""Penn Treebank pipeline (reference C8: the PTB text batcher with BPTT
+windows inside dl_trainer.py).
+
+Standard LM batching: concatenate the whole split into one token stream,
+chop into ``batch_size`` parallel streams, slide a ``bptt``-token window; a
+batch is (tokens i32[B, T], targets i32[B, T]) with targets = tokens shifted
+by one. Hidden state carries across consecutive windows (the trainer resets
+it at epoch boundaries), which is why sharding is over *stream rows*: each
+rank owns batch_size contiguous rows of a batch_size*nworkers-row corpus so
+its windows stay temporally consecutive — the reference partitioned PTB the
+same way (a rank must see its own rows every step for the carry to be valid).
+
+Real path reads ``ptb.{train,valid,test}.txt`` (word-level, vocab built from
+train). Synthetic fallback: a Zipf-distributed token stream over the full
+10k vocab.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, Iterator
+
+import numpy as np
+
+from gtopkssgd_tpu.data.partition import split_id as _split_id
+
+VOCAB_SIZE = 10000
+SYNTH_TOKENS = {"train": 200_000, "valid": 40_000, "test": 40_000}
+
+
+@functools.lru_cache(maxsize=4)
+def _synth_tokens(split: str, seed: int) -> np.ndarray:
+    """Zipf token stream; cached so P rank objects share one array, seeded
+    stably (crc32, not hash()) so every process derives the same corpus."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, _split_id(split)]))
+    stream = rng.zipf(1.3, SYNTH_TOKENS[split]).astype(np.int64)
+    return np.clip(stream, 1, VOCAB_SIZE - 1).astype(np.int32)
+
+
+class PTBDataset:
+    bptt_default = 35
+
+    def __init__(self, *, split="train", batch_size=20, rank=0, nworkers=1,
+                 data_dir=None, seed=0, bptt=35):
+        self.split = "valid" if split in ("val", "valid") else split
+        self.batch_size = batch_size
+        self.bptt = bptt
+        path = os.path.join(data_dir or "", f"ptb.{self.split}.txt")
+        self.synthetic = not os.path.isfile(path)
+        if self.synthetic:
+            self.tokens = _synth_tokens(self.split, seed)
+            self.vocab_size = VOCAB_SIZE
+            self.vocab = None
+        else:
+            self.vocab = self._build_vocab(
+                os.path.join(data_dir or "", "ptb.train.txt")
+            )
+            self.vocab_size = len(self.vocab)
+            self.tokens = self._tokenize(path)
+        # Global layout: (batch_size * nworkers) rows; this rank owns rows
+        # [rank*B, (rank+1)*B). Rows are contiguous token spans => carry valid.
+        rows = batch_size * nworkers
+        total = (len(self.tokens) - 1) // rows * rows
+        usable = self.tokens[: total + 1]
+        self.row_len = total // rows
+        grid = usable[:-1].reshape(rows, self.row_len)
+        tgt = usable[1:].reshape(rows, self.row_len)
+        lo, hi = rank * batch_size, (rank + 1) * batch_size
+        self.inputs = grid[lo:hi]
+        self.targets = tgt[lo:hi]
+        if self.row_len < self.bptt:
+            raise ValueError(
+                f"rows of {self.row_len} tokens are shorter than one "
+                f"bptt window ({self.bptt}) — lower batch_size or nworkers"
+            )
+
+    @staticmethod
+    def _build_vocab(train_path: str):
+        words = open(train_path).read().replace("\n", " <eos> ").split()
+        vocab = {"<unk>": 0}
+        for w in sorted(set(words)):
+            vocab.setdefault(w, len(vocab))
+        return vocab
+
+    def _tokenize(self, path: str) -> np.ndarray:
+        words = open(path).read().replace("\n", " <eos> ").split()
+        unk = self.vocab.get("<unk>", 0)
+        return np.asarray([self.vocab.get(w, unk) for w in words], np.int32)
+
+    def steps_per_epoch(self) -> int:
+        return self.row_len // self.bptt
+
+    def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        for lo in range(0, self.row_len - self.bptt + 1, self.bptt):
+            yield {
+                "tokens": self.inputs[:, lo:lo + self.bptt],
+                "targets": self.targets[:, lo:lo + self.bptt],
+            }
+
+    def __iter__(self):
+        e = 0
+        while True:
+            yield from self.epoch(e)
+            e += 1

@@ -3,38 +3,32 @@ deepspeech.pytorch lineage — SequenceWise batchnorm + BatchRNN stacks).
 
 Architecture (DeepSpeech-2 style, sized down for AN4's ~1h of audio):
 a 2-layer strided conv front-end over the (time, freq) spectrogram, a stack
-of bidirectional LSTM layers with sequence-wise BatchNorm between them, and
-a per-frame linear head over the character vocabulary, trained with CTC
-(the reference needed the native warp-ctc CUDA lib for this; here the loss
-is `optax.ctc_loss`, pure XLA — see gtopkssgd_tpu.trainer).
+of bidirectional LSTM layers with per-feature BatchNorm between them (flax
+BatchNorm over [B, T, F] reduces over batch*time — exactly the reference's
+`SequenceWise(nn.BatchNorm1d)` semantics), and a per-frame linear head over
+the character vocabulary, trained with CTC (the reference needed the native
+warp-ctc CUDA lib; here the loss is `optax.ctc_loss`, pure XLA — see
+gtopkssgd_tpu.trainer).
 
-TPU-native: the BiLSTM is two `lax.scan` directions (`flax.linen.Bidirectional`),
-convs NHWC in the compute dtype.
+Variable-length batches: pass ``input_lengths`` (pre-conv frame counts) and
+the recurrences honor them — in particular the backward direction of each
+BiLSTM starts at the true end of the utterance, not the padded tail
+(``flax.linen.RNN(seq_lengths=...)``). BatchNorm statistics still include
+padded frames (padding is zeros; acceptable bias, documented).
+
+TPU-native: the BiLSTM is two `lax.scan` directions, convs NHWC in the
+compute dtype.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
 
-# Default char vocabulary size: blank + ' + A..Z + space + padding slots,
-# matching deepspeech-style English char models (29 labels incl. blank at 0).
+# Blank at 0, then apostrophe, A..Z, space — deepspeech English labels (29).
 AN4_NUM_CHARS = 29
-
-
-class SequenceWiseBatchNorm(nn.Module):
-    """BatchNorm over the collapsed (batch*time) dim — the reference model's
-    `SequenceWise(nn.BatchNorm1d)` trick, which normalizes per-feature over
-    every frame in the batch."""
-
-    @nn.compact
-    def __call__(self, x, *, train: bool = False):  # x: [B, T, F]
-        b, t, f = x.shape
-        y = x.reshape(b * t, f)
-        y = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(y)
-        return y.reshape(b, t, f)
 
 
 class DeepSpeechAN4(nn.Module):
@@ -44,31 +38,38 @@ class DeepSpeechAN4(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, *, train: bool = False):
-        """x: f32[B, T, F] log-spectrograms. Returns per-frame logits
-        f32[B, T', num_chars] with T' = T/4 (two stride-2 convs in time)."""
+    def __call__(self, x, input_lengths=None, *, train: bool = False):
+        """x: f32[B, T, F] log-spectrograms; input_lengths: i32[B] valid
+        pre-conv frame counts (None = all T valid). Returns per-frame logits
+        f32[B, T', num_chars] with T' = output_length(T)."""
         b = x.shape[0]
+        norm = lambda: nn.BatchNorm(use_running_average=not train,
+                                    dtype=jnp.float32)
         y = x[..., None]  # [B, T, F, 1]
         y = nn.Conv(32, (11, 41), strides=(2, 2), padding=((5, 5), (20, 20)),
                     use_bias=False, dtype=self.dtype)(y)
-        y = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(y)
-        y = nn.hard_tanh(y)
+        y = nn.hard_tanh(norm()(y))
         y = nn.Conv(32, (11, 21), strides=(2, 2), padding=((5, 5), (10, 10)),
                     use_bias=False, dtype=self.dtype)(y)
-        y = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(y)
-        y = nn.hard_tanh(y)
+        y = nn.hard_tanh(norm()(y))
         # [B, T', F', 32] -> [B, T', F'*32]
         y = y.reshape(b, y.shape[1], -1)
+        seq_lengths = (
+            None if input_lengths is None else self.output_length(input_lengths)
+        )
         for layer in range(self.rnn_layers):
             if layer > 0:
-                y = SequenceWiseBatchNorm()(y, train=train)
+                # Per-feature stats over batch*time: the reference's
+                # SequenceWise(BatchNorm1d) — flax reduces all non-feature
+                # axes of [B, T, F], which is the same computation.
+                y = norm()(y)
             bi = nn.Bidirectional(
                 nn.RNN(nn.OptimizedLSTMCell(self.rnn_hidden, dtype=self.dtype)),
                 nn.RNN(nn.OptimizedLSTMCell(self.rnn_hidden, dtype=self.dtype)),
                 merge_fn=lambda a, b: a + b,  # sum-merge keeps width constant
             )
-            y = bi(y)
-        y = SequenceWiseBatchNorm()(y, train=train)
+            y = bi(y, seq_lengths=seq_lengths)
+        y = norm()(y)
         logits = nn.Dense(self.num_chars, dtype=self.dtype)(y)
         return logits.astype(jnp.float32)
 

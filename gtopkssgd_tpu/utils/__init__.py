@@ -1,0 +1,17 @@
+"""Support layer (reference L0: settings.py + utils.py — flags, logger,
+timer dicts, log accumulators) plus what the reference lacked: structured
+metrics and real checkpointing.
+"""
+
+from gtopkssgd_tpu.utils.timers import StepTimer, TimingStats
+from gtopkssgd_tpu.utils.metrics import MetricsLogger
+from gtopkssgd_tpu.utils.checkpoint import CheckpointManager
+from gtopkssgd_tpu.utils.settings import get_logger
+
+__all__ = [
+    "StepTimer",
+    "TimingStats",
+    "MetricsLogger",
+    "CheckpointManager",
+    "get_logger",
+]

@@ -1,0 +1,50 @@
+"""Checkpoint/resume via Orbax (reference C1 saved only the model
+state_dict at epoch boundaries and silently LOST the compressor residuals
+on resume — SURVEY.md §5. Here the whole training state is one pytree, so
+the error-feedback residual, momentum, step count, and data-epoch position
+all survive a restart).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax CheckpointManager for one state pytree.
+
+    The state must be a pure pytree of arrays/scalars (the trainer's
+    TrainState qualifies — residual included, since it lives in opt_state).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+        self._mgr.wait_until_finished()
+        return saved
+
+    def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(state_template)
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def close(self) -> None:
+        self._mgr.close()

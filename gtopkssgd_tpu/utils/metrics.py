@@ -1,0 +1,44 @@
+"""Structured metrics (upgrade over the reference's text-only logging).
+
+The reference's de-facto metrics pipeline was parsing per-rank text logs
+(SURVEY.md §5). Here every record is appended as one JSON line to
+``metrics.jsonl`` AND logged as the familiar human-readable line, so both
+machine analysis and eyeballs work.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+class MetricsLogger:
+    def __init__(self, out_dir: Optional[str] = None,
+                 logger: Optional[logging.Logger] = None, rank: int = 0):
+        self.logger = logger
+        self.rank = rank
+        self._fh = None
+        if out_dir is not None and rank == 0:
+            os.makedirs(out_dir, exist_ok=True)
+            self._fh = open(os.path.join(out_dir, "metrics.jsonl"), "a")
+
+    def log(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        rec = {"kind": kind, "time": time.time(), "rank": self.rank, **fields}
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        if self.logger is not None:
+            human = " ".join(
+                f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in fields.items()
+            )
+            self.logger.info("[%s] %s", kind, human)
+        return rec
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

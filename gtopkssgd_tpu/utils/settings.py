@@ -1,0 +1,38 @@
+"""Global knobs + logging (reference L0: settings.py — module constants,
+debug/profiling switches, logger creation).
+
+The reference kept a module-level logger writing per-rank log files (the
+launch scripts tee'd stdout per host). Here one helper builds a logger
+tagged with the process index; everything else that was a settings.py
+constant is an explicit dataclass/CLI flag in the trainer instead.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+DEBUG = bool(int(os.environ.get("GTOPK_DEBUG", "0")))
+# Flag-guarded per-step timing decomposition (reference profiling switch).
+PROFILING = bool(int(os.environ.get("GTOPK_PROFILING", "1")))
+
+_FMT = "%(asctime)s [%(name)s:r{rank}] %(levelname)s %(message)s"
+
+
+def get_logger(name: str = "gtopk", rank: int = 0,
+               log_file: str | None = None) -> logging.Logger:
+    logger = logging.getLogger(f"{name}.r{rank}")
+    if logger.handlers:
+        return logger
+    logger.setLevel(logging.DEBUG if DEBUG else logging.INFO)
+    fmt = logging.Formatter(_FMT.format(rank=rank), "%H:%M:%S")
+    sh = logging.StreamHandler(sys.stdout)
+    sh.setFormatter(fmt)
+    logger.addHandler(sh)
+    if log_file:
+        fh = logging.FileHandler(log_file)
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    logger.propagate = False
+    return logger

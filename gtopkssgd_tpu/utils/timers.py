@@ -1,0 +1,72 @@
+"""Per-step timing decomposition (reference L0: the wall-clock timer dicts
+in utils.py / the profiling switch in settings.py).
+
+The reference accumulated forward/backward/compression/communication times
+into dicts and logged them every N iterations — that decomposition is the
+paper's own analysis axis. Here the same split, plus `jax.block_until_ready`
+fencing so the async dispatch queue doesn't fold every phase into the last.
+
+For phases fused inside one jitted step (the production path — XLA overlaps
+comm and compute, so a host-side timer *cannot* see them separately), use
+the benchmark harness's segmented mode which jits each phase apart; this
+timer then reports whole-step time under 'step'.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+import jax
+
+PHASES = ("io", "forward", "backward", "compress", "comm", "update", "step")
+
+
+class TimingStats:
+    """Accumulates per-phase seconds; reference utils.py's timer-dict shape."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = collections.defaultdict(float)
+        self.counts: Dict[str, int] = collections.defaultdict(int)
+
+    def add(self, phase: str, seconds: float) -> None:
+        self.totals[phase] += seconds
+        self.counts[phase] += 1
+
+    def mean(self, phase: str) -> float:
+        c = self.counts[phase]
+        return self.totals[phase] / c if c else 0.0
+
+    def summary(self) -> Dict[str, float]:
+        return {p: self.mean(p) for p in self.totals}
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
+
+class StepTimer:
+    """Context-manager timer: ``with timer('forward'): ...``.
+
+    ``sync=True`` (default) blocks on JAX's async queue before reading the
+    clock, so the phase really finished; pass sync=False for host-only
+    phases like data loading.
+    """
+
+    def __init__(self, stats: TimingStats | None = None):
+        self.stats = stats or TimingStats()
+
+    @contextmanager
+    def __call__(self, phase: str, *, sync: bool = True, value=None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync:
+                if value is not None:
+                    jax.block_until_ready(value)
+                else:
+                    jax.effects_barrier()
+            self.stats.add(phase, time.perf_counter() - t0)

@@ -1,0 +1,98 @@
+"""Data pipelines: sharding disjointness, shapes, determinism, synthetic
+fallbacks. The reference had no pipeline tests at all (SURVEY.md §4) —
+sharding bugs there would surface only as wrong convergence curves.
+"""
+
+import numpy as np
+import pytest
+
+from gtopkssgd_tpu.data import (
+    available_datasets,
+    get_dataset,
+    partition_indices,
+)
+
+
+def test_partition_disjoint_and_covering():
+    n, p = 103, 4
+    shards = [partition_indices(n, r, p, seed=1, epoch=2) for r in range(p)]
+    allidx = np.concatenate(shards)
+    assert len(allidx) == n
+    assert len(set(allidx.tolist())) == n  # disjoint cover
+    # deterministic across calls, different across epochs
+    again = partition_indices(n, 2, p, seed=1, epoch=2)
+    np.testing.assert_array_equal(shards[2], again)
+    other_epoch = partition_indices(n, 2, p, seed=1, epoch=3)
+    assert not np.array_equal(shards[2], other_epoch)
+    with pytest.raises(ValueError):
+        partition_indices(n, 4, p)
+
+
+def test_registry():
+    assert {"cifar10", "imagenet", "ptb", "an4"} <= set(available_datasets())
+    with pytest.raises(ValueError):
+        get_dataset("mnist")
+
+
+def test_cifar_synthetic_batches():
+    ds = get_dataset("cifar10", batch_size=16, rank=0, nworkers=2)
+    assert ds.synthetic
+    batch = next(iter(ds))
+    assert batch["image"].shape == (16, 32, 32, 3)
+    assert batch["image"].dtype == np.float32
+    assert batch["label"].shape == (16,) and batch["label"].dtype == np.int32
+    assert ds.steps_per_epoch() > 0
+    # normalized: roughly zero-mean
+    assert abs(batch["image"].mean()) < 1.0
+
+
+def test_cifar_rank_shards_disjoint_same_epoch():
+    a = get_dataset("cifar10", batch_size=8, rank=0, nworkers=2, augment=False)
+    b = get_dataset("cifar10", batch_size=8, rank=1, nworkers=2, augment=False)
+    ia = a.partitioner.indices(0)
+    ib = b.partitioner.indices(0)
+    assert not set(ia.tolist()) & set(ib.tolist())
+
+
+def test_cifar_eval_deterministic():
+    ds = get_dataset("cifar10", split="test", batch_size=8)
+    b1 = next(iter(ds))
+    b2 = next(iter(get_dataset("cifar10", split="test", batch_size=8)))
+    np.testing.assert_array_equal(b1["image"], b2["image"])
+
+
+def test_imagenet_synthetic():
+    ds = get_dataset("imagenet", batch_size=4, num_classes=50)
+    batch = next(iter(ds))
+    assert batch["image"].shape == (4, 224, 224, 3)
+    assert batch["label"].max() < 50
+
+
+def test_ptb_bptt_windows_and_carry_layout():
+    ds = get_dataset("ptb", batch_size=4, bptt=35)
+    it = iter(ds)
+    b1, b2 = next(it), next(it)
+    assert b1["tokens"].shape == (4, 35)
+    # targets are tokens shifted by one within the stream
+    np.testing.assert_array_equal(b1["targets"][:, :-1], b1["tokens"][:, 1:])
+    # consecutive windows are temporally contiguous (carry validity)
+    np.testing.assert_array_equal(b2["tokens"][:, 0], b1["targets"][:, -1])
+    assert ds.vocab_size == 10000
+
+
+def test_ptb_rank_rows_disjoint():
+    a = get_dataset("ptb", batch_size=4, rank=0, nworkers=2)
+    b = get_dataset("ptb", batch_size=4, rank=1, nworkers=2)
+    assert not np.array_equal(a.inputs, b.inputs)
+    assert a.inputs.shape == b.inputs.shape
+
+
+def test_an4_synthetic_ctc_batches():
+    ds = get_dataset("an4", batch_size=4)
+    batch = next(iter(ds))
+    b, t, f = batch["spectrogram"].shape
+    assert (b, f) == (4, 161) and t % 16 == 0
+    assert batch["labels"].shape[0] == 4
+    assert (batch["input_lengths"] <= t).all()
+    assert (batch["label_lengths"] > 0).all()
+    assert (batch["labels"] < ds.num_chars).all()

@@ -47,10 +47,17 @@ def test_registry_lists_reference_workloads():
     ],
 )
 def test_vision_param_counts(name, expected_params, tol):
+    # Shape-only: eval_shape traces without compiling/executing, so the big
+    # ImageNet models cost milliseconds here instead of minutes.
     model, spec = get_model(name)
-    variables, out = init_and_apply(model, spec, batch=1)
+    rng = jax.random.PRNGKey(0)
+    x = jnp.zeros((1,) + tuple(spec.example_shape), jnp.float32)
+    variables = jax.eval_shape(
+        lambda: model.init({"params": rng, "dropout": rng}, x)
+    )
     got = n_params(variables)
     assert abs(got - expected_params) / expected_params <= tol, got
+    out = jax.eval_shape(lambda v: model.apply(v, x), variables)
     classes = 10 if spec.dataset == "cifar10" else 1000
     assert out.shape == (1, classes)
     assert out.dtype == jnp.float32
