@@ -3,17 +3,17 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": R}
 
-where value is the gtopk (rho=0.001) training-step throughput per chip and
-vs_baseline is its ratio to the dense-psum baseline measured in the same
-run on the same hardware — the reference's own headline comparison (paper:
-gTop-k vs dense S-SGD scaling efficiency; BASELINE.json north star:
-">= dense-allreduce images/sec/chip").
+value = gtopk (rho=0.001) fused-train-step throughput per chip;
+vs_baseline = ratio to the dense-psum baseline measured in the same run on
+the same hardware — the reference's own headline comparison (paper: gTop-k
+vs dense S-SGD scaling efficiency; BASELINE.json north star: ">= dense-
+allreduce images/sec/chip").
 
-The measured step is the full production path: forward + backward +
-error-feedback compress + collective + SGD update, jitted as one SPMD
-program over every visible chip. Batches are device-resident and fixed so
-the number isolates the framework/step pipeline, not host data generation
-(the -D flag in dist_trainer measures the full input pipeline instead).
+The measured step is the full production path (forward + backward + error-
+feedback compress + collective + SGD update) in one jitted SPMD program
+over every visible chip, with fixed device-resident batches (isolates the
+framework step from host input pipelines; benchmarks/sweep.py has the full
+grid and the per-phase breakdown).
 
 Usage: python bench.py [--dnn resnet20] [--batch-size 256] [--steps 40]
 """
@@ -22,91 +22,8 @@ from __future__ import annotations
 
 import argparse
 import json
-import time
 
 import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-from jax import lax
-from jax.sharding import PartitionSpec as P
-
-
-def build_step(model, tx, p, mesh, has_bn):
-    def step(state, batch):
-        params, bs, opt_state = state
-        x, y = jax.tree.map(lambda b: b[0], batch)
-
-        def loss_fn(params):
-            variables = {"params": params}
-            if has_bn:
-                variables["batch_stats"] = bs
-            out = model.apply(variables, x, train=True,
-                              mutable=["batch_stats"] if has_bn else [],
-                              rngs={"dropout": jax.random.PRNGKey(0)})
-            logits, new_bs = out if has_bn else (out, bs)
-            if has_bn:
-                new_bs = new_bs["batch_stats"]
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, y
-            ).mean()
-            return loss, new_bs
-
-        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        if p > 1:
-            loss = lax.pmean(loss, "dp")
-            if has_bn:
-                new_bs = jax.tree.map(lambda a: lax.pmean(a, "dp"), new_bs)
-        return (params, new_bs, opt_state), loss
-
-    if p == 1:
-        return jax.jit(step, donate_argnums=0)
-    return jax.jit(
-        jax.shard_map(
-            step, mesh=mesh,
-            in_specs=(P(), P("dp")),
-            out_specs=(P(), P()),
-            check_vma=False,
-        ),
-        donate_argnums=0,
-    )
-
-
-def measure(mode, density, args, mesh, p):
-    from gtopkssgd_tpu.models import get_model
-    from gtopkssgd_tpu.optimizer import gtopk_sgd
-
-    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    model, spec = get_model(args.dnn, dtype=dtype)
-    has_bn = spec.has_batchnorm
-    rng = jax.random.PRNGKey(0)
-    shape = (args.batch_size,) + tuple(spec.example_shape)
-    x1 = jax.random.normal(rng, (1,) + shape[1:])
-    variables = model.init({"params": rng, "dropout": rng}, x1)
-    tx = gtopk_sgd(
-        0.1, momentum=0.9, compression=mode, density=density,
-        topk_method=args.topk_method, axis_name="dp" if p > 1 else None,
-    )
-    params = variables["params"]
-    bs = variables.get("batch_stats", {})
-    state = (params, bs, jax.jit(tx.init)(params))
-    classes = 10 if spec.dataset == "cifar10" else 1000
-    x = jax.random.normal(rng, (p,) + shape)
-    y = jax.random.randint(rng, (p, args.batch_size), 0, classes)
-    step = build_step(model, tx, p, mesh, has_bn)
-    # warmup (compile + 2 steps)
-    for _ in range(3):
-        state, loss = step(state, (x, y))
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, loss = step(state, (x, y))
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    imgs_per_sec = args.steps * args.batch_size * p / dt
-    return imgs_per_sec / p  # per chip
 
 
 def main():
@@ -120,18 +37,24 @@ def main():
     ap.add_argument("--topk-method", default="auto")
     args = ap.parse_args()
 
-    from gtopkssgd_tpu.parallel import make_mesh
+    from gtopkssgd_tpu.benchmark import BenchConfig, measure_throughput
 
+    cfg = BenchConfig(
+        dnn=args.dnn, batch_size=args.batch_size, steps=args.steps,
+        density=args.density, dtype=args.dtype, topk_method=args.topk_method,
+    )
+    gtopk = measure_throughput(cfg, "gtopk", args.density)
+    dense = measure_throughput(cfg, "dense", 1.0)
     p = jax.device_count()
-    mesh = make_mesh(p)
-    gtopk = measure("gtopk", args.density, args, mesh, p)
-    dense = measure("dense", 1.0, args, mesh, p)
     print(json.dumps({
         "metric": f"{args.dnn}_gtopk_rho{args.density}_train_throughput"
                   f"_{p}chip",
-        "value": round(gtopk, 2),
+        "value": round(gtopk["images_per_sec_per_chip"], 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(gtopk / dense, 4),
+        "vs_baseline": round(
+            gtopk["images_per_sec_per_chip"]
+            / dense["images_per_sec_per_chip"], 4
+        ),
     }))
 
 

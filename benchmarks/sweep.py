@@ -1,0 +1,64 @@
+"""Density sweep + timing breakdown (BASELINE.json configs #3/#5; the
+reference ran this as a family of mpirun scripts over --density values).
+
+Usage:
+  python benchmarks/sweep.py --dnn resnet20 --densities 1 0.01 0.001 0.0001
+  python benchmarks/sweep.py --breakdown --dnn resnet20
+
+Writes one JSON line per point to stdout and (optionally) a JSONL file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gtopkssgd_tpu.benchmark import (
+    BenchConfig,
+    measure_breakdown,
+    measure_throughput,
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dnn", default="resnet20")
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--densities", type=float, nargs="+",
+                    default=[1.0, 0.01, 0.001, 0.0001])
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--topk-method", default="auto")
+    ap.add_argument("--breakdown", action="store_true",
+                    help="per-phase decomposition instead of fused step")
+    ap.add_argument("--out", default=None, help="append JSONL here too")
+    args = ap.parse_args()
+
+    cfg = BenchConfig(
+        dnn=args.dnn, batch_size=args.batch_size, steps=args.steps,
+        dtype=args.dtype, topk_method=args.topk_method,
+    )
+    fh = open(args.out, "a") if args.out else None
+    points = [("dense", 1.0)] + [("gtopk", d) for d in args.densities
+                                 if d < 1.0]
+    points += [("allgather", d) for d in args.densities if d < 1.0]
+    for mode, density in points:
+        fn = measure_breakdown if args.breakdown else measure_throughput
+        rec = fn(cfg, mode, density)
+        rec["dnn"] = cfg.dnn
+        line = json.dumps(rec)
+        print(line)
+        sys.stdout.flush()
+        if fh:
+            fh.write(line + "\n")
+            fh.flush()
+    if fh:
+        fh.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,234 @@
+"""Benchmark harness (reference C9/§5: the throughput logging + the paper's
+forward/backward/compress/comm decomposition, which is its own analysis
+axis — Fig. breakdowns in arXiv:1901.04359).
+
+Two measurements:
+
+  * ``measure_throughput`` — the production fused step (everything in one
+    jitted SPMD program) timed end to end. This is the honest number: XLA
+    overlaps compression/comm/compute, which host timers cannot decompose.
+  * ``measure_breakdown`` — each phase jitted SEPARATELY (forward+backward /
+    compress / collective / apply) and timed with device sync. The sum
+    exceeds the fused step time (no overlap, extra boundaries) — the split
+    is for analysis, exactly like the reference's timer dicts.
+
+Batches are fixed and device-resident: these measure the framework step,
+not host input pipelines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from gtopkssgd_tpu.compression import get_compressor
+from gtopkssgd_tpu.models import get_model
+from gtopkssgd_tpu.modes import DENSE_MODES
+from gtopkssgd_tpu.optimizer import gtopk_sgd
+from gtopkssgd_tpu.ops import scatter_add_dense
+from gtopkssgd_tpu.parallel import (
+    comm_bytes_per_step,
+    make_mesh,
+    sparse_allreduce,
+)
+
+
+@dataclasses.dataclass
+class BenchConfig:
+    dnn: str = "resnet20"
+    batch_size: int = 256
+    steps: int = 40
+    density: float = 0.001
+    dtype: str = "bfloat16"
+    topk_method: str = "auto"
+    nworkers: int = 0  # 0 = all devices
+
+
+def _setup(cfg: BenchConfig, mode: Optional[str], density: float):
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    model, spec = get_model(cfg.dnn, dtype=dtype)
+    rng = jax.random.PRNGKey(0)
+    shape = (cfg.batch_size,) + tuple(spec.example_shape)
+    variables = model.init(
+        {"params": rng, "dropout": rng}, jnp.zeros((1,) + shape[1:])
+    )
+    tx = gtopk_sgd(
+        0.1, momentum=0.9, compression=mode, density=density,
+        topk_method=cfg.topk_method, axis_name="dp",
+    )
+    return model, spec, variables, tx, shape
+
+
+def _timeit(fn: Callable, args, steps: int) -> float:
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def measure_throughput(cfg: BenchConfig, mode: Optional[str],
+                       density: float) -> Dict[str, float]:
+    """Fused-step images/sec/chip for one (mode, density) point."""
+    p = cfg.nworkers or jax.device_count()
+    mesh = make_mesh(p)
+    model, spec, variables, tx, shape = _setup(cfg, mode, density)
+    has_bn = spec.has_batchnorm
+    classes = 10 if spec.dataset == "cifar10" else 1000
+    rng = jax.random.PRNGKey(1)
+    x = jax.random.normal(rng, (p,) + shape)
+    y = jax.random.randint(rng, (p, cfg.batch_size), 0, classes)
+    params = variables["params"]
+    bs = variables.get("batch_stats", {})
+
+    def step(state, batch):
+        params, bstats, opt_state = state
+        xb, yb = jax.tree.map(lambda b: b[0], batch)
+
+        def loss_fn(params):
+            v = {"params": params}
+            if has_bn:
+                v["batch_stats"] = bstats
+            out = model.apply(v, xb, train=True,
+                              mutable=["batch_stats"] if has_bn else [],
+                              rngs={"dropout": jax.random.PRNGKey(0)})
+            logits, nbs = out if has_bn else (out, bstats)
+            if has_bn:
+                nbs = nbs["batch_stats"]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean(), nbs
+
+        (loss, nbs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, nbs, opt_state), lax.pmean(loss, "dp")
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), P("dp")), out_specs=(P(), P()),
+        check_vma=False,
+    ))
+    state = (params, bs, jax.jit(tx.init)(params))
+
+    def run(state):
+        state, loss = fn(state, (x, y))
+        return state, loss
+
+    # warmup
+    for _ in range(2):
+        state, loss = run(state)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(cfg.steps):
+        state, loss = run(state)
+    jax.block_until_ready(loss)
+    sec = (time.perf_counter() - t0) / cfg.steps
+    n = sum(a.size for a in jax.tree.leaves(params))
+    k = get_compressor(mode, density).k(n)
+    return {
+        "mode": mode or "dense",
+        "density": density,
+        "sec_per_step": sec,
+        "images_per_sec_per_chip": cfg.batch_size / sec,
+        "comm_bytes_model": comm_bytes_per_step(mode, n, k, p),
+        "num_params": n,
+        "nworkers": p,
+    }
+
+
+def measure_breakdown(cfg: BenchConfig, mode: Optional[str],
+                      density: float) -> Dict[str, float]:
+    """Per-phase seconds (forward+backward / compress / comm / apply), each
+    jitted and synced separately — the reference's timer-dict decomposition."""
+    p = cfg.nworkers or jax.device_count()
+    mesh = make_mesh(p)
+    model, spec, variables, tx, shape = _setup(cfg, mode, density)
+    has_bn = spec.has_batchnorm
+    classes = 10 if spec.dataset == "cifar10" else 1000
+    rng = jax.random.PRNGKey(1)
+    xb = jax.random.normal(rng, shape)
+    yb = jax.random.randint(rng, (cfg.batch_size,), 0, classes)
+    params = variables["params"]
+    bstats = variables.get("batch_stats", {})
+    from jax.flatten_util import ravel_pytree
+
+    flat0, unravel = ravel_pytree(params)
+    n = flat0.shape[0]
+    dense_mode = mode in DENSE_MODES
+    compressor = get_compressor(mode, density, cfg.topk_method)
+    k = compressor.k(n)
+
+    def fwd_bwd(params):
+        def loss_fn(params):
+            v = {"params": params}
+            if has_bn:
+                v["batch_stats"] = bstats
+            out = model.apply(v, xb, train=True,
+                              mutable=["batch_stats"] if has_bn else [],
+                              rngs={"dropout": jax.random.PRNGKey(0)})
+            logits = out[0] if has_bn else out
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+        _, grads = jax.value_and_grad(loss_fn)(params)
+        return ravel_pytree(grads)[0]
+
+    def compress(flat, residual):
+        acc = compressor.accumulate(flat, residual)
+        return compressor.compress(acc)
+
+    def _sparse_body(v, i):
+        r, gi, _ = sparse_allreduce(
+            mode, v[0], i[0], k=k, n=n, axis_name="dp", axis_size=p
+        )
+        if gi is None:
+            return r[None], jnp.zeros((1, 1), jnp.int32)
+        return r[None], gi[None]
+
+    # jit ONCE outside the timed call — rebuilding the jit per call would
+    # time retracing, not the collective.
+    comm_gtopk = jax.jit(jax.shard_map(
+        _sparse_body, mesh=mesh, in_specs=(P("dp"), P("dp")),
+        out_specs=(P("dp"), P("dp")), check_vma=False,
+    ))
+    comm_dense = jax.jit(jax.shard_map(
+        lambda f: lax.psum(f[0], "dp")[None], mesh=mesh,
+        in_specs=P("dp"), out_specs=P("dp"), check_vma=False,
+    ))
+
+    def apply_updates(params, dense_grad):
+        return optax.apply_updates(
+            params, jax.tree.map(lambda g: -0.1 * g, unravel(dense_grad))
+        )
+
+    res: Dict[str, float] = {"mode": mode or "dense", "density": density}
+    jf = jax.jit(fwd_bwd)
+    flat = jf(params)
+    res["forward_backward"] = _timeit(jf, (params,), cfg.steps)
+    if dense_mode:
+        flats = jnp.broadcast_to(flat, (p,) + flat.shape)
+        res["compress"] = 0.0
+        res["comm"] = _timeit(comm_dense, (flats,), cfg.steps)
+        dense_grad = flat
+    else:
+        residual = compressor.init_residual(n)
+        jc = jax.jit(compress)
+        vals, idx, _ = jc(flat, residual)
+        res["compress"] = _timeit(jc, (flat, residual), cfg.steps)
+        valss = jnp.broadcast_to(vals, (p,) + vals.shape)
+        idxs = jnp.broadcast_to(idx, (p,) + idx.shape)
+        res["comm"] = _timeit(comm_gtopk, (valss, idxs), cfg.steps)
+        dense_grad = scatter_add_dense(n, idx, vals)
+    ja = jax.jit(apply_updates)
+    res["apply"] = _timeit(ja, (params, dense_grad), cfg.steps)
+    res["sum"] = sum(v for q, v in res.items()
+                     if q in ("forward_backward", "compress", "comm", "apply"))
+    return res

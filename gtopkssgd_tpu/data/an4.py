@@ -116,17 +116,15 @@ class AN4Dataset:
         }
 
     def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        """Batches are padded to the FIXED (max_frames, max_label_len) shape,
+        not the per-batch maximum: static shapes are what XLA wants (one
+        compile), and fixed shapes are what lets the trainer stack shards
+        from P ranks / nsteps_update micro-batches into one array."""
         idx = self.partitioner.indices(epoch)
         b = self.batch_size
+        t_max, l_max = self.max_frames, self.max_label_len
         for lo in range(0, len(idx) - b + 1, b):
             utts = [self._load(i) for i in idx[lo:lo + b]]
-            t_max = min(
-                self.max_frames,
-                -(-max(u["spec"].shape[0] for u in utts) // 16) * 16,
-            )
-            l_max = min(
-                self.max_label_len, max(len(u["labels"]) for u in utts)
-            )
             spec = np.zeros((b, t_max, N_BINS), np.float32)
             labels = np.zeros((b, l_max), np.int32)
             in_len = np.zeros((b,), np.int32)

@@ -95,16 +95,18 @@ class CIFAR10Dataset:
         return len(self.partitioner) // self.batch_size
 
     def _augment(self, x: np.ndarray) -> np.ndarray:
+        """Fused pad+crop+flip+normalize. RNG draws happen here (numpy side)
+        so the C++ and fallback paths are bit-identical; the pixel work runs
+        in the native library when built (gtopkssgd_tpu.native)."""
+        from gtopkssgd_tpu import native
+
         b = x.shape[0]
-        padded = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
-        out = np.empty_like(x)
-        ys = self._rng.integers(0, 9, b)
-        xs = self._rng.integers(0, 9, b)
-        flip = self._rng.random(b) < 0.5
-        for i in range(b):
-            crop = padded[i, ys[i]:ys[i] + 32, xs[i]:xs[i] + 32]
-            out[i] = crop[:, ::-1] if flip[i] else crop
-        return out
+        ys = self._rng.integers(0, 9, b).astype(np.int32)
+        xs = self._rng.integers(0, 9, b).astype(np.int32)
+        flips = self._rng.random(b) < 0.5
+        return native.cifar_augment_batch(
+            x, ys, xs, flips, CIFAR_MEAN, CIFAR_STD
+        )
 
     def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         """One pass over this rank's shard, in the shared per-epoch order."""
@@ -113,9 +115,10 @@ class CIFAR10Dataset:
             sel = idx[lo:lo + self.batch_size]
             x = self.images[sel]
             if self.augment:
-                x = self._augment(x)
-            x = (x - CIFAR_MEAN) / CIFAR_STD
-            yield {"image": x.astype(np.float32), "label": self.labels[sel]}
+                x = self._augment(x)  # normalization fused in
+            else:
+                x = ((x - CIFAR_MEAN) / CIFAR_STD).astype(np.float32)
+            yield {"image": x, "label": self.labels[sel]}
 
     def __iter__(self):
         """Endless stream across epochs (what the training loop consumes)."""

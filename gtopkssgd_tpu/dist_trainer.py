@@ -48,7 +48,8 @@ def build_argparser() -> argparse.ArgumentParser:
                         "allreduce; allgather/topk = DGC-style union")
     p.add_argument("--density", type=float, default=0.001)
     p.add_argument("--topk-method", default="auto",
-                   choices=["auto", "exact", "blockwise", "approx", "pallas"])
+                   choices=["auto", "exact", "blockwise", "approx",
+                            "threshold", "pallas"])
     p.add_argument("--clip-grad-norm", type=float, default=None)
     p.add_argument("--nsteps-update", type=int, default=1,
                    help="gradient accumulation micro-steps per comm round")

@@ -1,0 +1,134 @@
+"""Native host runtime: C++ data-prep library behind ctypes (reference
+parity for the native code the reference consumed via torchvision/numpy —
+SURVEY.md §2 "Native / C++ / CUDA components" table).
+
+The library is compiled on demand with g++ (no pybind11 in this image —
+plain C ABI + ctypes, per the environment constraints). Everything has a
+pure-numpy fallback, so the package works with no toolchain; `available()`
+reports which path is active.
+
+Split of labor: Python/numpy draws ALL randomness (so native and fallback
+paths are bit-identical and testable), C++ does the per-pixel/per-cell
+loops, threaded across the batch.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "dataprep.cpp")
+_SO = os.path.join(_DIR, "libgtopk_dataprep.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+             _SRC, "-o", _SO],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.cifar_augment_batch.argtypes = [
+            f32p, f32p, ctypes.c_int, i32p, i32p, u8p, f32p, f32p,
+        ]
+        lib.cifar_augment_batch.restype = None
+        lib.edit_distance.argtypes = [i32p, ctypes.c_int, i32p, ctypes.c_int]
+        lib.edit_distance.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def cifar_augment_batch(
+    images: np.ndarray,  # f32[B,32,32,3] in [0,1]
+    ys: np.ndarray,      # i32[B] crop offsets in [0, 8]
+    xs: np.ndarray,
+    flips: np.ndarray,   # bool[B]
+    mean: np.ndarray,    # f32[3]
+    std: np.ndarray,     # f32[3]
+) -> np.ndarray:
+    """Fused reflect-pad(4) + random-crop(32) + hflip + normalize.
+
+    Native when the library is available, else the numpy reference
+    implementation — bit-identical results either way.
+    """
+    images = np.ascontiguousarray(images, np.float32)
+    b = images.shape[0]
+    lib = load()
+    if lib is not None:
+        out = np.empty_like(images)
+        lib.cifar_augment_batch(
+            images, out, b,
+            np.ascontiguousarray(ys, np.int32),
+            np.ascontiguousarray(xs, np.int32),
+            np.ascontiguousarray(flips, np.uint8),
+            np.ascontiguousarray(mean, np.float32),
+            np.ascontiguousarray(std, np.float32),
+        )
+        return out
+    # numpy fallback (same semantics)
+    padded = np.pad(images, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
+    out = np.empty_like(images)
+    for i in range(b):
+        crop = padded[i, ys[i]:ys[i] + 32, xs[i]:xs[i] + 32]
+        out[i] = crop[:, ::-1] if flips[i] else crop
+    return ((out - mean) / std).astype(np.float32)
+
+
+def edit_distance(a, b) -> int:
+    """Levenshtein distance between two int sequences."""
+    lib = load()
+    if lib is not None:
+        aa = np.ascontiguousarray(a, np.int32)
+        bb = np.ascontiguousarray(b, np.int32)
+        return int(lib.edit_distance(aa, len(aa), bb, len(bb)))
+    if not len(a):
+        return len(b)
+    if not len(b):
+        return len(a)
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[-1] + 1,
+                           prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]

@@ -10,6 +10,7 @@ from gtopkssgd_tpu.ops.topk import (
     topk_abs,
     blockwise_topk_abs,
     approx_topk_abs,
+    threshold_topk_abs,
     select_topk,
     k_for_density,
     merge_sparse_sets,
@@ -22,6 +23,7 @@ __all__ = [
     "topk_abs",
     "blockwise_topk_abs",
     "approx_topk_abs",
+    "threshold_topk_abs",
     "select_topk",
     "k_for_density",
     "merge_sparse_sets",

@@ -1,23 +1,108 @@
-"""Pallas TPU kernel for large-N magnitude top-k (torch.topk CUDA parity).
+"""Pallas TPU kernels for large-N magnitude top-k (the reference's
+`torch.topk` CUDA obligation — SURVEY.md §2 native table, §7 step 6).
 
-Status: the dedicated kernel is not implemented yet; `select_topk(...,
-method="pallas")` raises with a pointer to the supported methods. The lax
-formulations in ops/topk.py ("exact"/"blockwise") are the production paths
-until profiling on hardware justifies the hand-written kernel (SURVEY.md §7
-build-order step 6).
+Design ("threshold-estimate + compact", the strategy SURVEY.md names):
+exact top-k over a flat f32[N] needs a selection threshold tau = the k-th
+largest |x|. We find tau by monotone multisection — each round evaluates
+``count(|x| >= t)`` for 8 candidate thresholds — then compact the <= cap
+surviving elements and run one small exact `lax.top_k` over them (see
+ops.topk.threshold_topk_abs for the full algorithm).
+
+The hot primitive is the counting pass: 8 thresholds x one full read of x.
+XLA would issue 8 separate N-element reductions (8 HBM passes); the Pallas
+kernel below fuses them into ONE pass — read a VMEM block once, compare
+against all 8 thresholds, accumulate 8 counts. The TPU grid is sequential
+per core, so cross-block accumulation into the same output block is safe
+(standard grid-accumulation pattern).
+
+`lax.top_k` itself cannot lower inside a Pallas TPU kernel (verified:
+NotImplementedError in the pinned jax), which is exactly why the kernel
+computes threshold counts instead of doing in-kernel selection.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 Array = jax.Array
 
+NUM_THRESHOLDS = 8
+# One grid step processes BLOCK_ROWS x 128 elements from VMEM.
+BLOCK_ROWS = 2048
+_LANES = 128
+_BLOCK = BLOCK_ROWS * _LANES
 
-def pallas_topk_abs(x: Array, k: int) -> Tuple[Array, Array]:
-    raise NotImplementedError(
-        "the Pallas top-k kernel is not implemented yet; use "
-        "method='blockwise' (exact, TPU-friendly) or 'exact'"
+
+def _count_kernel(thr_ref, x_ref, out_ref):
+    """Accumulate counts of |x_block| >= thr for all 8 thresholds.
+
+    thr_ref: SMEM (NUM_THRESHOLDS,) f32 — candidate thresholds.
+    x_ref:   VMEM (BLOCK_ROWS, 128) f32 — this grid step's block (|x|,
+             pre-padded with -1 which no threshold >= 0 counts).
+    out_ref: SMEM (1, NUM_THRESHOLDS) i32 — running counts (same block for
+             every grid step: sequential accumulation; scalar stores must
+             target SMEM on TPU).
+    """
+    first = pl.program_id(0) == 0
+    mag = x_ref[:]
+
+    def body(i, _):
+        t = thr_ref[i]
+        c = jnp.sum((mag >= t).astype(jnp.int32))
+        prev = jnp.where(first, 0, out_ref[0, i])  # SMEM: scalar ops only
+        out_ref[0, i] = prev + c
+        return 0
+
+    jax.lax.fori_loop(0, NUM_THRESHOLDS, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def multi_threshold_count(
+    mag: Array, thresholds: Array, *, interpret: bool = False
+) -> Array:
+    """counts[i] = #{ j : mag[j] >= thresholds[i] } in ONE memory pass.
+
+    mag: f32[N] (non-negative; callers pass |x|). thresholds: f32[8].
+    """
+    n = mag.shape[0]
+    nblocks = max(1, -(-n // _BLOCK))
+    padded = nblocks * _BLOCK
+    # Pad with -1: strictly below any threshold >= 0, so never counted.
+    mag2 = jnp.pad(mag, (0, padded - n), constant_values=-1.0)
+    mag2 = mag2.reshape(nblocks * BLOCK_ROWS, _LANES)
+    counts = pl.pallas_call(
+        _count_kernel,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (BLOCK_ROWS, _LANES),
+                lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, NUM_THRESHOLDS), lambda i: (0, 0), memory_space=pltpu.SMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, NUM_THRESHOLDS), jnp.int32),
+        interpret=interpret,
+    )(thresholds, mag2)
+    return counts[0]
+
+
+def pallas_topk_abs(x: Array, k: int, *, interpret: bool = False
+                    ) -> Tuple[Array, Array]:
+    """Exact (up to boundary ties) magnitude top-k using the Pallas counting
+    kernel for threshold search. Same contract as ops.topk.topk_abs."""
+    from gtopkssgd_tpu.ops.topk import threshold_topk_abs
+
+    return threshold_topk_abs(
+        x, k,
+        count_fn=functools.partial(multi_threshold_count, interpret=interpret),
     )

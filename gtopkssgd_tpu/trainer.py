@@ -36,6 +36,7 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from gtopkssgd_tpu import native
 from gtopkssgd_tpu.data import get_dataset
 from gtopkssgd_tpu.models import get_model
 from gtopkssgd_tpu.optimizer import gtopk_sgd
@@ -111,8 +112,10 @@ class TrainState(NamedTuple):
 class Trainer:
     def __init__(self, config: TrainConfig):
         self.cfg = cfg = config.resolved()
-        self.logger = get_logger("trainer")
-        self.metrics = MetricsLogger(cfg.out_dir, self.logger)
+        self.process_rank = jax.process_index()
+        self.logger = get_logger("trainer", rank=self.process_rank)
+        self.metrics = MetricsLogger(cfg.out_dir, self.logger,
+                                     rank=self.process_rank)
         self.timer = StepTimer()
 
         self.model, self.spec = get_model(
@@ -121,13 +124,20 @@ class Trainer:
         self.mesh = make_mesh(cfg.nworkers)
         self.p = cfg.nworkers
 
+        # In a multi-host run each process feeds only the mesh positions its
+        # own devices occupy; make_array_from_process_local_data assembles
+        # the global [P, ...] batch (single host: all ranks are local).
+        self.local_ranks = [
+            i for i, d in enumerate(self.mesh.devices.flat)
+            if d.process_index == self.process_rank
+        ]
         data_kw = dict(
             batch_size=cfg.batch_size, data_dir=cfg.data_dir, seed=cfg.seed
         )
         self.train_shards = [
             get_dataset(cfg.dataset, split="train", rank=r,
                         nworkers=cfg.nworkers, **data_kw)
-            for r in range(cfg.nworkers)
+            for r in self.local_ranks
         ]
         self.val_data = get_dataset(cfg.dataset, split="test", **data_kw)
         self.steps_per_epoch = max(
@@ -148,13 +158,25 @@ class Trainer:
         self.state, self.carry = self._init_state()
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
+        # Checkpoints: written by process 0 only (state is replicated, so
+        # its copy is complete — see save()); every process can restore,
+        # assuming a shared filesystem for the checkpoint dir on multi-host.
         self._ckpt = (
             CheckpointManager(f"{cfg.out_dir}/ckpt") if cfg.out_dir else None
         )
-        # Persistent endless iterators: each dataset's __iter__ advances its
-        # own epoch permutation internally, so consecutive train() calls see
-        # fresh data (the reference's sampler-epoch equivalent).
-        self._iters = [iter(s) for s in self.train_shards]
+        self._set_iters(start_epoch=0)
+
+    def _set_iters(self, start_epoch: int) -> None:
+        """(Re)create the persistent per-shard iterators from a given epoch
+        permutation — used at init and to fast-forward after restore."""
+
+        def gen(ds, start):
+            e = start
+            while True:
+                yield from ds.epoch(e)
+                e += 1
+
+        self._iters = [gen(s, start_epoch) for s in self.train_shards]
 
     # ------------------------------------------------------------------ lr
     def _lr_schedule(self):
@@ -357,9 +379,9 @@ class Trainer:
 
     # ------------------------------------------------------------- batches
     def _stack_shard_batches(self, iters) -> Dict[str, np.ndarray]:
-        """[P, nsteps_update, B, ...] host-side global batch; transposed to
-        [nsteps, P, B, ...]? No — shard_map consumes the LEADING dim, so the
-        layout is [P, nsteps, B, ...]."""
+        """[P_local, nsteps_update, B, ...] host-side batch — the leading
+        dim is the shard_map 'dp' dim; this process contributes its local
+        mesh positions only."""
         n = self.cfg.nsteps_update
         per_shard = []
         for it in iters:
@@ -371,6 +393,20 @@ class Trainer:
             k: np.stack([s[k] for s in per_shard]) for k in per_shard[0]
         }
 
+    def _device_batch(self, np_batch: Dict[str, np.ndarray]):
+        """Host batch -> device arrays sharded P('dp') over the mesh. In a
+        multi-host run the local [P_local, ...] block is this process's
+        contribution to the global [P, ...] array."""
+        if jax.process_count() == 1:
+            return {k: jnp.asarray(v) for k, v in np_batch.items()}
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, P("dp"))
+        return {
+            k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in np_batch.items()
+        }
+
     # -------------------------------------------------------------- train
     def train(self, num_iters: int, epoch: int = 0) -> Dict[str, float]:
         """Run `num_iters` optimizer steps (reference DLTrainer.train)."""
@@ -378,14 +414,15 @@ class Trainer:
         cfg = self.cfg
         t_start, samples = time.perf_counter(), 0
         last_loss, last_aux = float("nan"), {}
+        if num_iters <= 0:
+            return {"loss": float("nan"), "throughput": 0.0, "wall": 0.0}
         # Host-side mirror of state.step: reading int(self.state.step) would
         # block on the device every iteration and kill async IO/compute
         # overlap; the mirror is exact (the step increments by 1 per call).
         step = int(self.state.step)
         for _ in range(num_iters):
             with self.timer("io", sync=False):
-                batch = self._stack_shard_batches(iters)
-                batch = {k: jnp.asarray(v) for k, v in batch.items()}
+                batch = self._device_batch(self._stack_shard_batches(iters))
             self.state, self.carry, loss, aux = self._train_step(
                 self.state, self.carry, batch
             )
@@ -465,7 +502,7 @@ class Trainer:
                     seq.append(int(c))
                 prev = c
             ref = labels[b, : lab_len[b]].tolist()
-            errors += _edit_distance(seq, ref)
+            errors += native.edit_distance(seq, ref)
             total += max(1, len(ref))
         return errors / total
 
@@ -476,7 +513,11 @@ class Trainer:
         cfg = self.cfg
         epochs = max_epochs or cfg.max_epochs
         result = {}
-        for epoch in range(epochs):
+        # Resume-aware: a restored state at step S has completed S /
+        # steps_per_epoch epochs; train only the remainder (restore() already
+        # fast-forwarded the data iterators to this epoch's permutation).
+        start_epoch = int(self.state.step) // self.steps_per_epoch
+        for epoch in range(start_epoch, epochs):
             self.reset_carry()  # BPTT state does not cross epochs (ref §3.4)
             train_stats = self.train(self.steps_per_epoch, epoch=epoch)
             result = {**train_stats, **self.test()}
@@ -495,7 +536,7 @@ class Trainer:
             )
 
     def save(self) -> None:
-        if self._ckpt is not None:
+        if self._ckpt is not None and self.process_rank == 0:
             self._ckpt.save(int(self.state.step), self._host_state())
 
     def restore(self) -> bool:
@@ -503,22 +544,12 @@ class Trainer:
             return False
         restored = self._ckpt.restore(self._host_state())
         self.state = jax.tree.map(jnp.asarray, restored)
+        # Fast-forward the data stream to the restored epoch's permutation
+        # (epoch-level granularity: checkpoints are written at epoch ends).
+        self._set_iters(int(self.state.step) // self.steps_per_epoch)
         return True
 
     def _host_state(self):
         return jax.tree.map(np.asarray, self.state)
 
 
-def _edit_distance(a, b) -> int:
-    """Levenshtein distance (host-side; eval only)."""
-    if not a:
-        return len(b)
-    if not b:
-        return len(a)
-    prev = list(range(len(b) + 1))
-    for i, ca in enumerate(a, 1):
-        cur = [i]
-        for j, cb in enumerate(b, 1):
-            cur.append(min(prev[j] + 1, cur[-1] + 1, prev[j - 1] + (ca != cb)))
-        prev = cur
-    return prev[-1]

@@ -1,8 +1,10 @@
 """Checkpoint/resume via Orbax (reference C1 saved only the model
 state_dict at epoch boundaries and silently LOST the compressor residuals
 on resume — SURVEY.md §5. Here the whole training state is one pytree, so
-the error-feedback residual, momentum, step count, and data-epoch position
-all survive a restart).
+the error-feedback residual, momentum, and step count all survive a
+restart; the trainer additionally fast-forwards the data stream to the
+restored epoch's permutation — epoch-level granularity, matching the
+epoch-boundary save cadence).
 """
 
 from __future__ import annotations

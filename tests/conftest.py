@@ -22,6 +22,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+# Persistent compilation cache: the suite's cost is dominated by XLA:CPU
+# compiles of model train steps; caching them on disk makes repeated runs
+# (and identical HLO across tests) fast.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_gtopkssgd")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

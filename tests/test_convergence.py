@@ -1,0 +1,83 @@
+"""Convergence micro-test (SURVEY.md §4: gtopk at low density must track
+the dense loss curve — the reference's only correctness gate, shrunk to CI
+size). ResNet-20 on synthetic CIFAR, 4-way DP, 60 steps: the gtopk run at
+rho=0.01 must end within a modest factor of the dense run, and allgather
+(DGC union) likewise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from gtopkssgd_tpu.models import get_model
+from gtopkssgd_tpu.optimizer import gtopk_sgd
+from gtopkssgd_tpu.parallel import make_mesh
+
+PDEV, BATCH, STEPS = 4, 8, 40
+
+
+def run_mode(mode, density, seed=0):
+    model, spec = get_model("resnet20")
+    rng = jax.random.PRNGKey(seed)
+    variables = model.init({"params": rng}, jnp.zeros((1, 32, 32, 3)))
+    params, bstats = variables["params"], variables["batch_stats"]
+    tx = gtopk_sgd(0.05, momentum=0.9, compression=mode, density=density,
+                   axis_name="dp")
+    mesh = make_mesh(PDEV)
+
+    npr = np.random.default_rng(1)
+    X = jnp.asarray(npr.standard_normal((PDEV, BATCH, 32, 32, 3)), jnp.float32)
+    Y = jnp.asarray(npr.integers(0, 10, (PDEV, BATCH)), jnp.int32)
+
+    def step(params, bstats, opt_state, x, y):
+        x, y = x[0], y[0]
+
+        def loss_fn(params):
+            out, mut = model.apply(
+                {"params": params, "batch_stats": bstats}, x, train=True,
+                mutable=["batch_stats"],
+            )
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, y).mean(), mut["batch_stats"]
+
+        (loss, nbs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        nbs = jax.tree.map(lambda a: lax.pmean(a, "dp"), nbs)
+        return params, nbs, opt_state, lax.pmean(loss, "dp")
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P(), P()), check_vma=False,
+    ))
+    opt_state = jax.jit(tx.init)(params)
+    losses = []
+    for _ in range(STEPS):
+        params, bstats, opt_state, loss = fn(params, bstats, opt_state, X, Y)
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.fixture(scope="module")
+def dense_losses():
+    return run_mode("dense", 1.0)
+
+
+def test_dense_overfits(dense_losses):
+    assert dense_losses[-1] < 0.35 * dense_losses[0], dense_losses[::10]
+
+
+def test_gtopk_tracks_dense(dense_losses):
+    gtopk = run_mode("gtopk", 0.01)
+    # error feedback at 1% density: slower but must clearly converge
+    assert gtopk[-1] < 0.5 * gtopk[0], gtopk[::10]
+    assert gtopk[-1] < dense_losses[0]
+
+
+def test_allgather_tracks_dense(dense_losses):
+    dgc = run_mode("allgather", 0.01)
+    assert dgc[-1] < 0.5 * dgc[0], dgc[::10]

@@ -1,0 +1,59 @@
+"""Native C++ data-prep library vs its numpy fallback (bit-identical by
+construction: randomness is drawn on the numpy side in both paths).
+"""
+
+import numpy as np
+import pytest
+
+from gtopkssgd_tpu import native
+from gtopkssgd_tpu.data.cifar import CIFAR_MEAN, CIFAR_STD
+
+
+def numpy_reference_augment(images, ys, xs, flips, mean, std):
+    padded = np.pad(images, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
+    out = np.empty_like(images)
+    for i in range(images.shape[0]):
+        crop = padded[i, ys[i]:ys[i] + 32, xs[i]:xs[i] + 32]
+        out[i] = crop[:, ::-1] if flips[i] else crop
+    return ((out - mean) / std).astype(np.float32)
+
+
+def test_native_builds_here():
+    # The toolchain is present in this image; the library must build.
+    assert native.available()
+
+
+def test_augment_matches_numpy_reference(rng):
+    b = 16
+    images = rng.random((b, 32, 32, 3)).astype(np.float32)
+    ys = rng.integers(0, 9, b).astype(np.int32)
+    xs = rng.integers(0, 9, b).astype(np.int32)
+    flips = rng.random(b) < 0.5
+    got = native.cifar_augment_batch(images, ys, xs, flips, CIFAR_MEAN, CIFAR_STD)
+    want = numpy_reference_augment(images, ys, xs, flips, CIFAR_MEAN, CIFAR_STD)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_augment_edge_offsets(rng):
+    # extreme crops (0 and 8) exercise the reflect-pad boundary logic
+    b = 4
+    images = rng.random((b, 32, 32, 3)).astype(np.float32)
+    ys = np.array([0, 8, 0, 8], np.int32)
+    xs = np.array([8, 0, 0, 8], np.int32)
+    flips = np.array([True, False, True, False])
+    got = native.cifar_augment_batch(images, ys, xs, flips, CIFAR_MEAN, CIFAR_STD)
+    want = numpy_reference_augment(images, ys, xs, flips, CIFAR_MEAN, CIFAR_STD)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("a,b,d", [
+    ([], [], 0),
+    ([1, 2, 3], [], 3),
+    ([1, 2, 3], [1, 2, 3], 0),
+    ([1, 2, 3], [1, 3], 1),
+    ([1, 2, 3, 4], [2, 3, 5], 2),
+    ([5, 5, 5], [5], 2),
+])
+def test_edit_distance(a, b, d):
+    assert native.edit_distance(a, b) == d
+    assert native.edit_distance(b, a) == d

@@ -1,0 +1,83 @@
+"""Threshold top-k + the Pallas counting kernel (interpret mode on CPU).
+
+Exactness oracle: numpy argsort. The threshold method must match exactly on
+continuous-valued inputs; adversarial ties are checked by selected-mass
+equivalence (tie-breaking may differ, total selected magnitude may not).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gtopkssgd_tpu.ops import threshold_topk_abs, topk_abs
+from gtopkssgd_tpu.ops.pallas_topk import (
+    NUM_THRESHOLDS,
+    multi_threshold_count,
+    pallas_topk_abs,
+)
+
+
+def np_topk_set(x, k):
+    idx = np.argsort(-np.abs(x), kind="stable")[:k]
+    return set(idx.tolist())
+
+
+@pytest.mark.parametrize("n,k", [(1000, 10), (65536, 64), (100_000, 1000),
+                                 (1 << 20, 100)])
+def test_threshold_topk_exact_on_continuous(rng, n, k):
+    x = rng.standard_normal(n).astype(np.float32)
+    vals, idx = jax.jit(lambda a: threshold_topk_abs(a, k))(jnp.asarray(x))
+    got = set(np.asarray(idx).tolist())
+    want = np_topk_set(x, k)
+    assert got == want
+    np.testing.assert_allclose(
+        np.sort(np.asarray(vals)), np.sort(x[list(want)]), rtol=1e-6
+    )
+
+
+def test_threshold_topk_heavy_tail(rng):
+    # gradient-like: a few huge entries, many tiny
+    n, k = 200_000, 200
+    x = (rng.standard_normal(n) ** 5).astype(np.float32)
+    vals, idx = threshold_topk_abs(jnp.asarray(x), k)
+    assert set(np.asarray(idx).tolist()) == np_topk_set(x, k)
+
+
+def test_threshold_topk_ties_mass_equivalent(rng):
+    # adversarial: the boundary value repeated many times — tie-breaking may
+    # differ from argsort but the selected mass must match.
+    n, k = 10_000, 100
+    x = np.zeros(n, np.float32)
+    x[:50] = 10.0          # definite members
+    x[50:5000] = 1.0       # 4950-way tie across the boundary
+    vals, idx = threshold_topk_abs(jnp.asarray(x), k)
+    v = np.asarray(vals)
+    assert (v == 10.0).sum() == 50
+    assert (v == 1.0).sum() == 50
+    assert len(set(np.asarray(idx).tolist())) == k
+
+
+def test_multi_threshold_count_kernel_interpret(rng):
+    mag = np.abs(rng.standard_normal(70_000)).astype(np.float32)
+    thr = np.quantile(mag, [0.999, 0.99, 0.9, 0.7, 0.5, 0.3, 0.1, 0.01]
+                      ).astype(np.float32)
+    counts = multi_threshold_count(
+        jnp.asarray(mag), jnp.asarray(thr), interpret=True
+    )
+    want = [(mag >= t).sum() for t in thr]
+    np.testing.assert_array_equal(np.asarray(counts), want)
+    assert counts.shape == (NUM_THRESHOLDS,)
+
+
+def test_pallas_topk_interpret_matches_exact(rng):
+    n, k = 300_000, 300
+    x = rng.standard_normal(n).astype(np.float32)
+    vals, idx = pallas_topk_abs(jnp.asarray(x), k, interpret=True)
+    ev, ei = topk_abs(jnp.asarray(x), k)
+    assert set(np.asarray(idx).tolist()) == set(np.asarray(ei).tolist())
+
+
+def test_threshold_topk_all_zero():
+    vals, idx = threshold_topk_abs(jnp.zeros(5000), 8)
+    assert np.all(np.asarray(vals) == 0.0)

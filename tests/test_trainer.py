@@ -66,6 +66,23 @@ def test_an4_trainer_ctc():
     assert "val_cer" in ev and ev["val_cer"] >= 0.0
 
 
+def test_an4_distributed_accumulated_shapes_stack():
+    # Regression: AN4 batches must have fixed shapes so nworkers>1 and
+    # nsteps_update>1 can stack them (variable per-batch padding used to
+    # crash np.stack in _stack_shard_batches).
+    t = Trainer(small_cfg(dnn="lstman4", batch_size=2, nworkers=2,
+                          nsteps_update=2, compression="gtopk",
+                          density=0.05, eval_batches=1))
+    stats = t.train(2)
+    assert np.isfinite(stats["loss"])
+
+
+def test_train_zero_iters_is_noop():
+    t = Trainer(small_cfg())
+    stats = t.train(0)
+    assert stats["throughput"] == 0.0 and int(t.state.step) == 0
+
+
 def test_checkpoint_roundtrip_preserves_residual(tmp_path):
     cfg = small_cfg(compression="gtopk", density=0.05,
                     out_dir=str(tmp_path / "run"))
