@@ -1,0 +1,54 @@
+"""The driver contract: __graft_entry__ must work as invoked by the driver.
+
+Round-1 regression: dryrun_multichip asserted on jax.device_count() instead
+of provisioning virtual devices, so the driver's MULTICHIP check failed on
+the 1-chip machine. These tests run the entry exactly the way the driver
+does — `python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"`
+from the repo root — including from a parent process that only sees ONE
+device, which forces the subprocess self-provisioning path.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _driver_env(n_parent_devices: int) -> dict:
+    """Env for a parent process that sees n CPU devices (no TPU grab)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Strip any inherited forced-device-count so the parent sees exactly n.
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_parent_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def test_dryrun_multichip_self_provisions_from_one_device():
+    """Parent sees 1 device -> dryrun_multichip(8) must still pass (the
+    exact failure mode of MULTICHIP_r01)."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu');"
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        env=_driver_env(1), cwd=REPO, capture_output=True, text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "one gtopk step OK" in proc.stdout
+
+
+def test_dryrun_multichip_direct_path():
+    """Parent already has >= 8 devices -> runs in-process."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu');"
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        env=_driver_env(8), cwd=REPO, capture_output=True, text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "one gtopk step OK" in proc.stdout
