@@ -9,9 +9,13 @@ hop, 161 bins at 16kHz), transcripts mapped over the 29-char vocabulary.
 Synthetic fallback: random utterances whose spectrogram is correlated with
 a random character sequence so CTC training has signal.
 
-Batches are padded to the longest utterance in the batch, with
-``input_lengths`` (pre-conv frame counts) and ``label_lengths`` for CTC —
-shapes rebucketed to multiples of 16 frames to bound XLA recompiles.
+Every batch is padded to ONE fixed ``(max_frames, max_label_len)`` shape
+(not the per-batch maximum): static shapes mean a single XLA compile, and
+fixed shapes are what lets the trainer stack shards from P ranks /
+nsteps_update micro-batches into one array. Utterances longer than
+``max_frames`` (or transcripts longer than ``max_label_len``) are
+truncated; the dataset counts these in ``truncated_count`` and logs a
+warning the first time it happens on the real-data path.
 """
 
 from __future__ import annotations
@@ -89,8 +93,13 @@ class AN4Dataset:
             self._utts = _synth_utterances(split, seed, self.num_chars)
             count = len(self._utts)
         else:
+            # Manifest entries may be relative (the portable/committable
+            # form) — resolve them against the manifest's own directory,
+            # like deepspeech manifests in practice.
+            mdir = os.path.dirname(os.path.abspath(manifest))
             self._manifest = [
-                line.strip().split(",")
+                [p if os.path.isabs(p) else os.path.join(mdir, p)
+                 for p in line.strip().split(",")]
                 for line in open(manifest)
                 if line.strip()
             ]
@@ -102,6 +111,8 @@ class AN4Dataset:
                 f"rank shard has {len(self.partitioner)} utterances < "
                 f"batch_size {batch_size} — lower batch_size or nworkers"
             )
+        self.truncated_count = 0
+        self._warned_truncation = False
 
     def steps_per_epoch(self) -> int:
         return len(self.partitioner) // self.batch_size
@@ -132,6 +143,20 @@ class AN4Dataset:
             for j, u in enumerate(utts):
                 t = min(u["spec"].shape[0], t_max)
                 l = min(len(u["labels"]), l_max)
+                if u["spec"].shape[0] > t_max or len(u["labels"]) > l_max:
+                    # Truncation silently drops CTC-visible audio/labels —
+                    # keep a count and warn once so a real-data run with a
+                    # too-small max_frames is noticed, not invisible.
+                    self.truncated_count += 1
+                    if not self._warned_truncation:
+                        self._warned_truncation = True
+                        import logging
+
+                        logging.getLogger("gtopkssgd_tpu.data.an4").warning(
+                            "utterance exceeds max_frames=%d/max_label_len=%d"
+                            " and was truncated (counting further cases in "
+                            "AN4Dataset.truncated_count)", t_max, l_max,
+                        )
                 spec[j, :t] = u["spec"][:t]
                 labels[j, :l] = u["labels"][:l]
                 in_len[j], lab_len[j] = t, l

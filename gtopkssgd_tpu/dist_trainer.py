@@ -69,6 +69,10 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="restore the latest checkpoint from out-dir")
     p.add_argument("--multihost", action="store_true",
                    help="call jax.distributed.initialize() first")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace (TensorBoard/Perfetto"
+                        " format) of --profile-steps early training steps")
+    p.add_argument("--profile-steps", type=int, default=10)
     return p
 
 
@@ -109,6 +113,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.resume:
         restored = trainer.restore()
         trainer.logger.info("resume: %s", "restored" if restored else "fresh")
+    if args.profile_dir:
+        # SURVEY.md §5 tracing: the reference only had host timer dicts;
+        # here a real jax.profiler device trace complements them. One step
+        # first so compilation stays out of the trace.
+        trainer.train(1)
+        jax.profiler.start_trace(args.profile_dir)
+        trainer.train(args.profile_steps)
+        jax.profiler.stop_trace()
+        trainer.logger.info("profiler: %d-step trace -> %s",
+                            args.profile_steps, args.profile_dir)
     if args.num_iters is not None:
         stats = trainer.train(args.num_iters)
         stats.update(trainer.test())

@@ -183,6 +183,26 @@ def gtopk_sgd(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def expand_residual_per_device(opt_state: GTopKSGDState, p: int, mesh):
+    """Lift the freshly-initialized [N] residual to the per-device [P, N]
+    convention used under shard_map (leading dim = 'dp'; strip with
+    residual[0] inside the block, restore with residual[None] on the way
+    out). The residual at init is zeros by construction, so the expansion
+    is built DIRECTLY into its P('dp') sharding — a host-side broadcast
+    would materialize the dense [P, N] array on one device first (1.6 GB
+    for ResNet-50 x 16 workers). Shared by the trainer and the benchmark
+    so their measured paths cannot drift.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    res_shape = (p,) + opt_state.residual.shape
+    res_dtype = opt_state.residual.dtype
+    return opt_state._replace(residual=jax.jit(
+        lambda: jnp.zeros(res_shape, res_dtype),
+        out_shardings=NamedSharding(mesh, PartitionSpec("dp")),
+    )())
+
+
 def effective_density(compression: Optional[str], density: float) -> float:
     """Density actually communicated (1.0 for the dense baseline) — used by
     the benchmark harness's comm-volume model."""

@@ -39,7 +39,11 @@ from jax.sharding import PartitionSpec as P
 from gtopkssgd_tpu import native
 from gtopkssgd_tpu.data import get_dataset
 from gtopkssgd_tpu.models import get_model
-from gtopkssgd_tpu.optimizer import gtopk_sgd
+from gtopkssgd_tpu.optimizer import (
+    GTopKSGDState,
+    expand_residual_per_device,
+    gtopk_sgd,
+)
 from gtopkssgd_tpu.parallel import make_mesh
 from gtopkssgd_tpu.utils import (
     CheckpointManager,
@@ -140,9 +144,17 @@ class Trainer:
             for r in self.local_ranks
         ]
         self.val_data = get_dataset(cfg.dataset, split="test", **data_kw)
-        self.steps_per_epoch = max(
-            1, self.train_shards[0].steps_per_epoch() // cfg.nsteps_update
-        )
+        # steps_per_epoch must be identical on EVERY process of a multi-host
+        # run (each step issues collectives; disagreement desyncs the SPMD
+        # program). The partitioner gives the last rank the dataset
+        # remainder, so derive the count from the MINIMUM shard size —
+        # a pure function of (n, nworkers, batch_size) every process agrees
+        # on — rather than from whichever shard happens to be local.
+        spe = self.train_shards[0].steps_per_epoch()
+        part = getattr(self.train_shards[0], "partitioner", None)
+        if part is not None and part.nworkers > 1:
+            spe = (part.n // part.nworkers) // cfg.batch_size
+        self.steps_per_epoch = max(1, spe // cfg.nsteps_update)
 
         self.tx = gtopk_sgd(
             self._lr_schedule(),
@@ -158,9 +170,9 @@ class Trainer:
         self.state, self.carry = self._init_state()
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
-        # Checkpoints: written by process 0 only (state is replicated, so
-        # its copy is complete — see save()); every process can restore,
-        # assuming a shared filesystem for the checkpoint dir on multi-host.
+        # Checkpoints: orbax save/restore of the live sharded state; on
+        # multi-host EVERY process participates (orbax coordinates; each
+        # writes its addressable residual shards) over a shared filesystem.
         self._ckpt = (
             CheckpointManager(f"{cfg.out_dir}/ckpt") if cfg.out_dir else None
         )
@@ -187,14 +199,16 @@ class Trainer:
         spe = self.steps_per_epoch
         base = cfg.lr
         if cfg.dataset == "cifar10":
-            # x0.1 at 50% and 75% of training (classic CIFAR recipe)
-            return optax.piecewise_constant_schedule(
-                base,
-                {
-                    int(cfg.max_epochs * 0.5) * spe: 0.1,
-                    int(cfg.max_epochs * 0.75) * spe: 0.1,
-                },
-            )
+            # x0.1 at 50% and 75% of training (classic CIFAR recipe). For
+            # tiny max_epochs the two boundaries can collide or land at
+            # step 0 (which would start training at 0.1x lr) — drop such
+            # degenerate boundaries instead of silently merging them.
+            boundaries = {}
+            for frac in (0.5, 0.75):
+                b = int(cfg.max_epochs * frac) * spe
+                if b > 0 and b not in boundaries:
+                    boundaries[b] = 0.1
+            return optax.piecewise_constant_schedule(base, boundaries)
         if cfg.dataset == "imagenet":
             return optax.piecewise_constant_schedule(
                 base, {30 * spe: 0.1, 60 * spe: 0.1, 80 * spe: 0.1}
@@ -220,6 +234,14 @@ class Trainer:
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         opt_state = jax.jit(self.tx.init)(params)
+        if self.p > 1:
+            # The error-feedback residual is genuinely PER-DEVICE state (it
+            # depends on each device's local gradients and top-k picks), so
+            # it is carried as an explicit [P, N] leaf sharded P('dp') —
+            # unlike the rest of the state, which is replicated.
+            # Checkpointing then captures every device's residual, not just
+            # device 0's.
+            opt_state = expand_residual_per_device(opt_state, self.p, self.mesh)
         n = sum(x.size for x in jax.tree.leaves(params))
         self.num_params = n
         self.logger.info(
@@ -301,7 +323,10 @@ class Trainer:
             logits, batch["label"]
         ).mean()
         top1 = (logits.argmax(-1) == batch["label"]).mean()
-        return loss, (new_bs, carry, {"top1": top1})
+        # top-5 (reference reported top-1/top-5 for vision — SURVEY.md §3.5)
+        _, top5_idx = lax.top_k(logits, min(5, logits.shape[-1]))
+        top5 = (top5_idx == batch["label"][:, None]).any(-1).mean()
+        return loss, (new_bs, carry, {"top1": top1, "top5": top5})
 
     # ------------------------------------------------------------ the step
     def _build_train_step(self):
@@ -313,17 +338,23 @@ class Trainer:
             if p > 1:
                 rng = jax.random.fold_in(rng, lax.axis_index("dp"))
 
-            def micro(acc, mb):
+            def micro(acc, xs):
+                mb, micro_idx = xs
                 grads_sum, bs, cr = acc
+                # Each micro-batch draws its own dropout mask (folding the
+                # scan index in) — sharing one mask across the accumulation
+                # would correlate the micro-gradients.
+                mrng = jax.random.fold_in(rng, micro_idx)
                 (loss, (bs, cr, aux)), grads = jax.value_and_grad(
                     self._loss_fn, has_aux=True
-                )(state.params, bs, cr, mb, rng, True)
+                )(state.params, bs, cr, mb, mrng, True)
                 grads_sum = jax.tree.map(jnp.add, grads_sum, grads)
                 return (grads_sum, bs, cr), (loss, aux)
 
             zero_grads = jax.tree.map(jnp.zeros_like, state.params)
             (grads, new_bs, new_carry), (losses, auxes) = lax.scan(
-                micro, (zero_grads, state.batch_stats, carry), batch
+                micro, (zero_grads, state.batch_stats, carry),
+                (batch, jnp.arange(cfg.nsteps_update)),
             )
             grads = jax.tree.map(lambda g: g / cfg.nsteps_update, grads)
             updates, opt_state = self.tx.update(
@@ -348,10 +379,18 @@ class Trainer:
         def shardwise(state, carry, batch):
             # Both the p==1 direct path and the per-device shard_map block
             # see a leading shard dim of size 1 — strip it, run, restore.
+            # The residual travels the same way: global [P, N], per-device
+            # [1, N] inside the block, [N] inside step().
             c = jax.tree.map(lambda a: a[0], carry) if carry != () else ()
+            if p > 1:
+                state = state._replace(opt_state=state.opt_state._replace(
+                    residual=state.opt_state.residual[0]))
             s, c2, loss, aux = step(
                 state, c, jax.tree.map(lambda b: b[0], batch)
             )
+            if p > 1:
+                s = s._replace(opt_state=s.opt_state._replace(
+                    residual=s.opt_state.residual[None]))
             if carry != ():
                 c2 = jax.tree.map(lambda a: a[None], c2)
             return s, c2, loss, aux
@@ -359,11 +398,25 @@ class Trainer:
         if p == 1:
             return jax.jit(shardwise, donate_argnums=(0, 1))
 
+        # Per-leaf specs: everything in the state is replicated EXCEPT the
+        # error-feedback residual, which is per-device ([P, N], sharded over
+        # 'dp'). check_vma stays off for a structural reason: the gtopk
+        # result is value-identical on every device (the hypercube merge is
+        # symmetric) but built from lax.ppermute exchanges, which the
+        # varying-axes checker must conservatively type as device-varying —
+        # it cannot prove value-level replication without an O(N) collective
+        # on the hot path. Replication of params/opt state is instead
+        # asserted by tests (tests/test_optimizer.py replica-consistency,
+        # tests/test_trainer.py::test_residual_sharding_multiworker).
+        state_spec = TrainState(
+            step=P(), params=P(), batch_stats=P(),
+            opt_state=GTopKSGDState(count=P(), residual=P("dp"), inner=P()),
+        )
         smapped = jax.shard_map(
             shardwise,
             mesh=self.mesh,
-            in_specs=(P(), P("dp"), P("dp")),
-            out_specs=(P(), P("dp"), P(), P()),
+            in_specs=(state_spec, P("dp"), P("dp")),
+            out_specs=(state_spec, P("dp"), P(), P()),
             check_vma=False,
         )
         return jax.jit(smapped, donate_argnums=(0, 1))
@@ -439,7 +492,11 @@ class Trainer:
                 if cfg.dataset == "ptb":
                     rec["ppl"] = float(np.exp(min(last_loss, 20.0)))
                 self.metrics.log("train", **rec)
-        jax.block_until_ready(self.state.params)
+        # true_sync, not block_until_ready: the tunneled TPU platform acks
+        # readiness before execution completes (utils/timers.py).
+        from gtopkssgd_tpu.utils import true_sync
+
+        true_sync(self.state.params)
         wall = time.perf_counter() - t_start
         return {
             "loss": float(loss),
@@ -454,8 +511,8 @@ class Trainer:
         vision, perplexity for PTB, greedy-decode CER for AN4."""
         cfg = self.cfg
         name = self.spec.name
-        losses, top1s, weights = [], [], []
-        cers = []
+        losses, top1s, top5s, weights = [], [], [], []
+        cer_counts = np.zeros(4, np.int64)  # char errs, chars, word errs, words
         carry = (
             self.model.initial_carry(cfg.batch_size) if name == "lstm" else ()
         )
@@ -470,41 +527,73 @@ class Trainer:
             weights.append(len(next(iter(batch.values()))))
             if "top1" in aux:
                 top1s.append(float(aux["top1"]))
+            if "top5" in aux:
+                top5s.append(float(aux["top5"]))
             if name == "lstman4":
-                cers.append(self._greedy_cer(jb, aux["logits"]))
+                cer_counts += self._greedy_error_counts(jb, aux["logits"])
         w = np.asarray(weights, np.float64)
         mean_loss = float(np.average(losses, weights=w)) if losses else float("nan")
         out = {"val_loss": mean_loss}
         if top1s:
             out["val_top1"] = float(np.average(top1s, weights=w))
+        if top5s:
+            out["val_top5"] = float(np.average(top5s, weights=w))
         if cfg.dataset == "ptb":
             out["val_ppl"] = float(np.exp(min(mean_loss, 20.0)))
-        if cers:
-            out["val_cer"] = float(np.mean(cers))
+        if cer_counts[1] > 0:
+            out["val_cer"] = float(cer_counts[0] / cer_counts[1])
+            out["val_wer"] = float(cer_counts[2] / max(1, cer_counts[3]))
         self.metrics.log("eval", step=int(self.state.step), **out)
         return out
 
-    def _greedy_cer(self, batch, logits) -> float:
-        """Greedy CTC decode + character error rate (reference used greedy
-        decode for WER/CER on AN4 — SURVEY.md §3.5). `logits` come from the
-        jitted eval step — no second forward pass."""
+    # Space in the 29-char AN4 vocabulary (LABELS = "_'A..Z ") — word
+    # boundary for WER.
+    _AN4_SPACE_ID = 28
+
+    def _greedy_error_counts(self, batch, logits) -> np.ndarray:
+        """Greedy CTC decode -> [char_errors, chars, word_errors, words]
+        (reference reported WER/CER for AN4 via greedy decode — SURVEY.md
+        §3.5). Error rates are aggregated corpus-level (sum of edit
+        distances / sum of reference lengths), the standard ASR convention.
+        `logits` come from the jitted eval step — no second forward pass;
+        the blank/repeat collapse is vectorized, only the per-utterance
+        edit distance (C++, gtopkssgd_tpu.native) runs in a loop."""
         pred = np.asarray(logits.argmax(-1))  # [B, T']
         out_len = np.asarray(self.model.output_length(batch["input_lengths"]))
         labels = np.asarray(batch["labels"])
         lab_len = np.asarray(batch["label_lengths"])
-        total, errors = 0, 0
-        for b in range(pred.shape[0]):
-            seq = []
-            prev = 0
-            for t in range(out_len[b]):
-                c = pred[b, t]
-                if c != 0 and c != prev:
-                    seq.append(int(c))
-                prev = c
+        bsz, t_out = pred.shape
+        valid = np.arange(t_out)[None, :] < out_len[:, None]
+        prev = np.concatenate(
+            [np.zeros((bsz, 1), pred.dtype), pred[:, :-1]], axis=1)
+        keep = valid & (pred != 0) & (pred != prev)
+
+        def words(seq):
+            out, cur = [], []
+            for c in seq:
+                if c == self._AN4_SPACE_ID:
+                    if cur:
+                        out.append(tuple(cur))
+                    cur = []
+                else:
+                    cur.append(c)
+            if cur:
+                out.append(tuple(cur))
+            return out
+
+        counts = np.zeros(4, np.int64)
+        for b in range(bsz):
+            seq = pred[b][keep[b]].tolist()
             ref = labels[b, : lab_len[b]].tolist()
-            errors += native.edit_distance(seq, ref)
-            total += max(1, len(ref))
-        return errors / total
+            counts[0] += native.edit_distance(seq, ref)
+            counts[1] += max(1, len(ref))
+            # word-level: map word tuples to ids, edit-distance those
+            sw, rw = words(seq), words(ref)
+            ids = {}
+            to_ids = lambda ws: [ids.setdefault(t, len(ids)) for t in ws]
+            counts[2] += native.edit_distance(to_ids(sw), to_ids(rw))
+            counts[3] += max(1, len(rw))
+        return counts
 
     # ----------------------------------------------------------- epochs/ckpt
     def fit(self, max_epochs: Optional[int] = None) -> Dict[str, float]:
@@ -536,20 +625,44 @@ class Trainer:
             )
 
     def save(self) -> None:
-        if self._ckpt is not None and self.process_rank == 0:
-            self._ckpt.save(int(self.state.step), self._host_state())
+        """Orbax save of the LIVE (sharded) state. Every process must call
+        this — orbax coordinates multi-host writes internally and each
+        process persists its addressable shards of the P('dp') residual;
+        a host-side numpy conversion would crash on multi-host (the
+        residual spans non-addressable devices) and was how round 1 lost
+        every rank-but-0 residual."""
+        if self._ckpt is not None:
+            self._ckpt.save(int(self.state.step), self.state)
 
     def restore(self) -> bool:
         if self._ckpt is None or self._ckpt.latest_step() is None:
             return False
-        restored = self._ckpt.restore(self._host_state())
-        self.state = jax.tree.map(jnp.asarray, restored)
+        # Abstract template with explicit shardings: orbax restores every
+        # leaf directly INTO its target placement — replicated over the
+        # mesh for params/step/momentum, P('dp') for the per-device
+        # residual (no dense single-device materialization, and every
+        # process of a multi-host run reads only its own residual shards).
+        self.state = self._ckpt.restore(self._state_template())
         # Fast-forward the data stream to the restored epoch's permutation
         # (epoch-level granularity: checkpoints are written at epoch ends).
         self._set_iters(int(self.state.step) // self.steps_per_epoch)
         return True
 
-    def _host_state(self):
-        return jax.tree.map(np.asarray, self.state)
+    def _state_template(self):
+        from jax.sharding import NamedSharding
+
+        rep = NamedSharding(self.mesh, P())
+
+        def leaf(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=rep)
+
+        template = jax.tree.map(leaf, self.state)
+        if self.p > 1:
+            res = self.state.opt_state.residual
+            template = template._replace(opt_state=template.opt_state._replace(
+                residual=jax.ShapeDtypeStruct(
+                    res.shape, res.dtype,
+                    sharding=NamedSharding(self.mesh, P("dp")))))
+        return template
 
 

@@ -1,0 +1,113 @@
+"""Multi-host path: 2 real processes over jax.distributed on CPU.
+
+Until round 2 the multi-host code (`jax.distributed.initialize`, the
+`make_array_from_process_local_data` batch assembly in
+Trainer._device_batch, per-process shard iterators) was dead code in every
+test. This launches TWO actual processes, each owning one CPU device of a
+2-device mesh, and runs distributed gtopk training steps across them —
+the closest single-machine analogue of the reference's `mpirun -np 2`
+smoke (SURVEY.md §4). Skipped cleanly if the jax build lacks CPU
+cross-process collectives.
+
+Also covers the profiler flag (VERDICT #9) in the single-process path.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import sys
+sys.path.insert(0, sys.argv[3])  # repo root (script itself lives in tmp)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_gtopkssgd")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+coord, pid = sys.argv[1], int(sys.argv[2])
+try:
+    jax.distributed.initialize(coordinator_address=coord, num_processes=2,
+                               process_id=pid)
+except Exception as e:  # unsupported build -> tell the parent to skip
+    print("DISTRIBUTED-UNSUPPORTED:", e)
+    raise SystemExit(99)
+assert jax.device_count() == 2 and jax.local_device_count() == 1
+import numpy as np
+from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+cfg = TrainConfig(dnn="resnet20", batch_size=4, nworkers=2,
+                  compression="gtopk", density=0.01, max_epochs=1,
+                  log_interval=1, eval_batches=1, out_dir=sys.argv[4])
+t = Trainer(cfg)
+stats = t.train(2)
+assert int(t.state.step) == 2
+assert np.isfinite(stats["loss"]), stats
+# multi-host checkpoint: every process participates (sharded residual)
+t.save()
+res_before = np.asarray(
+    t.state.opt_state.residual.addressable_shards[0].data)
+t2 = Trainer(cfg)
+assert t2.restore() and int(t2.state.step) == 2
+res_after = np.asarray(
+    t2.state.opt_state.residual.addressable_shards[0].data)
+np.testing.assert_array_equal(res_before, res_after)
+t2.train(1)
+assert int(t2.state.step) == 3
+print(f"MULTIHOST-OK pid={pid} loss={stats['loss']:.4f}")
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_gtopk(tmp_path):
+    port = _free_port()
+    coord = f"localhost:{port}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=1")
+    env["XLA_FLAGS"] = " ".join(flags)
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    out_dir = str(tmp_path / "run")
+    procs = [
+        subprocess.Popen([sys.executable, str(script), coord, str(pid),
+                          REPO, out_dir],
+                         env=env, cwd=REPO, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=850)
+        outs.append((p.returncode, out))
+    if any(rc == 99 for rc, _ in outs):
+        pytest.skip("jax build lacks CPU cross-process collectives: "
+                    + outs[0][1].splitlines()[-1])
+    for rc, out in outs:
+        assert rc == 0, out
+        assert "MULTIHOST-OK" in out
+
+
+def test_profile_dir_writes_trace(tmp_path):
+    from gtopkssgd_tpu.dist_trainer import main
+
+    prof = tmp_path / "prof"
+    rc = main(["--dnn", "resnet20", "--batch-size", "4", "--nworkers", "1",
+               "--num-iters", "1", "--eval-batches", "1",
+               "--profile-dir", str(prof), "--profile-steps", "2"])
+    assert rc == 0
+    # The trace lands under <dir>/plugins/profile/<run>/ with a .trace.json.gz
+    found = [f for f in prof.rglob("*") if f.is_file()]
+    assert any("trace" in f.name for f in found), found
