@@ -28,7 +28,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--dnn", default="resnet20")
     ap.add_argument("--batch-size", type=int, default=256)
-    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--steps", type=int, default=30,
+                    help="per-phase step count (--breakdown mode only; "
+                         "throughput mode uses --min-seconds windows)")
+    ap.add_argument("--min-seconds", type=float, default=2.0,
+                    help="throughput-mode timed window per point")
     ap.add_argument("--densities", type=float, nargs="+",
                     default=[1.0, 0.01, 0.001, 0.0001])
     ap.add_argument("--dtype", default="bfloat16")
@@ -40,7 +44,8 @@ def main():
 
     cfg = BenchConfig(
         dnn=args.dnn, batch_size=args.batch_size, steps=args.steps,
-        dtype=args.dtype, topk_method=args.topk_method,
+        min_seconds=args.min_seconds, dtype=args.dtype,
+        topk_method=args.topk_method,
     )
     fh = open(args.out, "a") if args.out else None
     points = [("dense", 1.0)] + [("gtopk", d) for d in args.densities

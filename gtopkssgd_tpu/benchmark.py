@@ -39,17 +39,57 @@ from gtopkssgd_tpu.parallel import (
     make_mesh,
     sparse_allreduce,
 )
+from gtopkssgd_tpu.utils import (
+    sync_round_trip_seconds,
+    timed_window,
+    true_sync,
+)
 
 
 @dataclasses.dataclass
 class BenchConfig:
-    dnn: str = "resnet20"
-    batch_size: int = 256
-    steps: int = 40
+    dnn: str = "resnet50"
+    batch_size: int = 128
+    steps: int = 40              # breakdown mode: fixed step count
+    min_seconds: float = 2.0     # throughput mode: time-based window
     density: float = 0.001
     dtype: str = "bfloat16"
     topk_method: str = "auto"
     nworkers: int = 0  # 0 = all devices
+
+
+# Peak dense matmul throughput per chip (bf16), for MFU. Keys match
+# jax.devices()[0].device_kind prefixes; unknown kinds report mfu=None
+# rather than a made-up number.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def _peak_flops_per_chip() -> Optional[float]:
+    kind = jax.devices()[0].device_kind
+    for prefix, peak in PEAK_FLOPS.items():
+        if kind.startswith(prefix):
+            return peak
+    return None
+
+
+def _compiled_flops(compiled) -> Optional[float]:
+    """Per-step FLOPs as XLA counts them (cost_analysis), None if absent."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", -1.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
 
 
 def _setup(cfg: BenchConfig, mode: Optional[str], density: float):
@@ -68,18 +108,50 @@ def _setup(cfg: BenchConfig, mode: Optional[str], density: float):
 
 
 def _timeit(fn: Callable, args, steps: int) -> float:
+    """Mean seconds per call via the shared honest timing loop
+    (utils/timers.py::timed_window: back-to-back dispatch, ONE D2H fence —
+    block_until_ready lies on the tunneled platform — round trip
+    subtracted, window grown until it dwarfs the round trip). The device
+    executes every enqueued launch in order, so fencing the last output
+    waits for all of them.
+    """
     out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / steps
+    rtt = sync_round_trip_seconds(out)
+
+    def chunk(c):
+        o = out
+        for _ in range(c):
+            o = fn(*args)
+        true_sync(o)
+
+    sec, _ = timed_window(chunk, rtt, 0.5, steps)
+    return sec
 
 
 def measure_throughput(cfg: BenchConfig, mode: Optional[str],
                        density: float) -> Dict[str, float]:
-    """Fused-step images/sec/chip for one (mode, density) point."""
+    """Fused-step images/sec/chip for one (mode, density) point.
+
+    Measurement discipline (round-1 lesson: a 40-step window blocked only
+    on `loss` — which does not depend on the param update — produced a
+    dispatch-dominated, physically implausible number):
+
+      * the timed window is TIME-based (>= cfg.min_seconds), not a fixed
+        step count, so it is orders of magnitude above dispatch noise;
+      * the clock stops only after a device-to-host read fences the FULL
+        updated state (params + opt state incl. residual) — NOT
+        jax.block_until_ready, which on the tunneled platform acks before
+        execution (utils/timers.py::true_sync) — so every dispatched
+        step's compute, including the collective and scatter-apply, is
+        inside the window, and the one fixed round trip is subtracted;
+      * per-step FLOPs come from the compiled executable's own
+        cost_analysis, giving achieved FLOP/s and MFU vs the chip's peak.
+    """
+    from gtopkssgd_tpu.optimizer import (
+        GTopKSGDState,
+        expand_residual_per_device,
+    )
+
     p = cfg.nworkers or jax.device_count()
     mesh = make_mesh(p)
     model, spec, variables, tx, shape = _setup(cfg, mode, density)
@@ -93,6 +165,9 @@ def measure_throughput(cfg: BenchConfig, mode: Optional[str],
 
     def step(state, batch):
         params, bstats, opt_state = state
+        # residual is per-device [1, N] inside the block (same convention
+        # as the trainer) — strip for the transform, restore on the way out
+        opt_state = opt_state._replace(residual=opt_state.residual[0])
         xb, yb = jax.tree.map(lambda b: b[0], batch)
 
         def loss_fn(params):
@@ -111,34 +186,62 @@ def measure_throughput(cfg: BenchConfig, mode: Optional[str],
         (loss, nbs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        opt_state = opt_state._replace(residual=opt_state.residual[None])
         return (params, nbs, opt_state), lax.pmean(loss, "dp")
 
-    fn = jax.jit(jax.shard_map(
-        step, mesh=mesh, in_specs=(P(), P("dp")), out_specs=(P(), P()),
-        check_vma=False,
-    ))
-    state = (params, bs, jax.jit(tx.init)(params))
+    state_spec = (P(), P(), GTopKSGDState(count=P(), residual=P("dp"),
+                                          inner=P()))
+    fn = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=(state_spec, P("dp")),
+            out_specs=(state_spec, P()), check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )
+    opt0 = expand_residual_per_device(jax.jit(tx.init)(params), p, mesh)
+    state = (params, bs, opt0)
+    batch = (x, y)
 
-    def run(state):
-        state, loss = fn(state, (x, y))
-        return state, loss
+    compiled = fn.lower(state, batch).compile()
+    flops_per_step = _compiled_flops(compiled)
 
-    # warmup
-    for _ in range(2):
-        state, loss = run(state)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(cfg.steps):
-        state, loss = run(state)
-    jax.block_until_ready(loss)
-    sec = (time.perf_counter() - t0) / cfg.steps
+    # Warmup: a few real steps, fenced with a D2H read (true_sync) — on
+    # the tunneled platform block_until_ready returns before execution.
+    for _ in range(3):
+        state, loss = compiled(state, batch)
+    rtt = sync_round_trip_seconds(state)
+
+    # Shared honest timing loop; the clock stops only after the FULL final
+    # state (params + residual + momentum) is executed.
+    box = [state]
+
+    def chunk(c):
+        s = box[0]
+        for _ in range(c):
+            s, _ = compiled(s, batch)
+        true_sync(s)
+        box[0] = s
+
+    sec, steps = timed_window(chunk, rtt, cfg.min_seconds, 8)
+
     n = sum(a.size for a in jax.tree.leaves(params))
     k = get_compressor(mode, density).k(n)
+    peak = _peak_flops_per_chip()
+    # cost_analysis reports PER-DEVICE flops for an SPMD-partitioned module
+    # (verified empirically on a 4-device mesh), so this is already /chip.
+    achieved = flops_per_step / sec if flops_per_step else None
     return {
         "mode": mode or "dense",
         "density": density,
         "sec_per_step": sec,
         "images_per_sec_per_chip": cfg.batch_size / sec,
+        "steps_timed": steps,
+        "window_seconds": sec * steps,
+        "flops_per_step": flops_per_step,
+        "achieved_tflops_per_chip": (
+            achieved / 1e12 if achieved is not None else None
+        ),
+        "mfu": (achieved / peak if achieved is not None and peak else None),
         "comm_bytes_model": comm_bytes_per_step(mode, n, k, p),
         "num_params": n,
         "nworkers": p,
@@ -223,8 +326,16 @@ def measure_breakdown(cfg: BenchConfig, mode: Optional[str],
         jc = jax.jit(compress)
         vals, idx, _ = jc(flat, residual)
         res["compress"] = _timeit(jc, (flat, residual), cfg.steps)
-        valss = jnp.broadcast_to(vals, (p,) + vals.shape)
-        idxs = jnp.broadcast_to(idx, (p,) + idx.shape)
+        # Per-device DISTINCT sparse sets: replicating one (vals, idx) to
+        # every device would hand the merge its cheapest case (all
+        # duplicates); real steps merge mostly-disjoint index sets.
+        keys = jax.random.split(jax.random.PRNGKey(2), p)
+        valss = jnp.stack([
+            vals * jax.random.normal(kk, vals.shape) for kk in keys
+        ])
+        idxs = jnp.stack([
+            jax.random.randint(kk, idx.shape, 0, n, jnp.int32) for kk in keys
+        ])
         res["comm"] = _timeit(comm_gtopk, (valss, idxs), cfg.steps)
         dense_grad = scatter_add_dense(n, idx, vals)
     ja = jax.jit(apply_updates)

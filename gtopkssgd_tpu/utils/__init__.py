@@ -3,7 +3,13 @@ timer dicts, log accumulators) plus what the reference lacked: structured
 metrics and real checkpointing.
 """
 
-from gtopkssgd_tpu.utils.timers import StepTimer, TimingStats
+from gtopkssgd_tpu.utils.timers import (
+    StepTimer,
+    TimingStats,
+    sync_round_trip_seconds,
+    timed_window,
+    true_sync,
+)
 from gtopkssgd_tpu.utils.metrics import MetricsLogger
 from gtopkssgd_tpu.utils.checkpoint import CheckpointManager
 from gtopkssgd_tpu.utils.settings import get_logger
@@ -11,6 +17,9 @@ from gtopkssgd_tpu.utils.settings import get_logger
 __all__ = [
     "StepTimer",
     "TimingStats",
+    "sync_round_trip_seconds",
+    "timed_window",
+    "true_sync",
     "MetricsLogger",
     "CheckpointManager",
     "get_logger",

@@ -24,6 +24,60 @@ import jax
 PHASES = ("io", "forward", "backward", "compress", "comm", "update", "step")
 
 
+def true_sync(tree) -> None:
+    """Block until every queued computation feeding ``tree`` has executed.
+
+    ``jax.block_until_ready`` is NOT sufficient on remote-tunneled
+    platforms: the 'axon' TPU proxy acks readiness before device execution
+    completes (measured: a 1.1 TFLOP matmul "ready" in 27us, i.e. 40
+    EFLOP/s — impossible), while a device-to-host read takes the honest
+    round trip. A D2H read of one element cannot return before the
+    executable that produced its buffer ran, and XLA executables run whole-
+    program, so one element of the LAST output in a dependency chain fences
+    the entire chain. Cost: one tunnel round trip (~66 ms here) — charge it
+    once per timing window, never per step.
+    """
+    leaves = [l for l in jax.tree.leaves(tree) if hasattr(l, "ravel")]
+    if not leaves:
+        return
+    leaf = leaves[0]
+    jax.device_get(leaf.ravel()[0:1] if leaf.size else leaf)
+
+
+def sync_round_trip_seconds(tree) -> float:
+    """Measured cost of one true_sync on already-materialized data — the
+    fixed host<->device round trip a timing window should subtract."""
+    true_sync(tree)  # materialize
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        true_sync(tree)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def timed_window(run_chunk, rtt: float, min_seconds: float = 0.5,
+                 initial_steps: int = 8):
+    """The one honest timing loop (round-1 lesson — keep it in ONE place).
+
+    ``run_chunk(steps)`` must dispatch `steps` calls back-to-back and fence
+    with true_sync. The window grows geometrically until it exceeds both
+    ``min_seconds`` and 20x the sync round trip, so tiny ops aren't drowned
+    in fixed sync overhead; the final window's single round trip is
+    subtracted. Returns (seconds_per_step, steps_timed).
+    """
+    floor = max(min_seconds, 20 * rtt)
+    steps = initial_steps
+    while True:
+        t0 = time.perf_counter()
+        run_chunk(steps)
+        elapsed = time.perf_counter() - t0
+        if elapsed >= floor:
+            return max(elapsed - rtt, 1e-9) / steps, steps
+        steps = int(steps * min(
+            10.0, max(2.0, 1.25 * floor / max(elapsed, 1e-4)))) + 1
+
+
 class TimingStats:
     """Accumulates per-phase seconds; reference utils.py's timer-dict shape."""
 
