@@ -1,0 +1,120 @@
+"""Top-k strategy sweep on real hardware — the evidence behind `auto`.
+
+The reference leans on `torch.topk`'s CUDA kernel (SURVEY.md §2 native
+table: the #1 custom-kernel obligation). The TPU rebuild has five
+strategies (ops/topk.py, ops/pallas_topk.py); this benchmark measures all
+of them at the reference's real problem sizes:
+
+    N = 2.7e5   (ResNet-20 CIFAR scale)
+    N = 2.5e7   (ResNet-50 ImageNet scale)
+    N = 6.1e7   (AlexNet/VGG-16 scale)
+
+with k = ceil(rho * N) at rho in {0.001, 0.01}, and writes a JSON artifact
+(benchmarks/results/topk_bench_<device>.json) so the choice of the
+production method is reproducible, not folklore. Timing uses the same
+discipline as the main benchmark: back-to-back dispatch, one D2H fence
+(true_sync — block_until_ready lies on the tunneled TPU), fixed round trip
+subtracted, window >> round trip.
+
+Run:  python -m benchmarks.topk_bench [--out PATH] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+
+from gtopkssgd_tpu.ops.topk import k_for_density, select_topk
+from gtopkssgd_tpu.utils import (
+    sync_round_trip_seconds,
+    timed_window,
+    true_sync,
+)
+
+SIZES = {
+    "resnet20-270k": 272_474,
+    "resnet50-25.6M": 25_557_032,
+    "vgg16-61M": 61_090_496,
+}
+DENSITIES = (0.001, 0.01)
+METHODS = ("exact", "blockwise", "threshold", "approx", "pallas")
+
+
+def time_method(method: str, n: int, k: int, min_seconds: float = 1.0):
+    x = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
+
+    if method == "pallas":
+        from gtopkssgd_tpu.ops.pallas_topk import pallas_topk_abs
+
+        interpret = jax.default_backend() != "tpu"
+        fn = jax.jit(lambda v: pallas_topk_abs(v, k, interpret=interpret))
+    else:
+        fn = jax.jit(lambda v: select_topk(v, k, method=method))
+
+    out = fn(x)
+    rtt = sync_round_trip_seconds(out)
+
+    def chunk(c):
+        o = out
+        for _ in range(c):
+            o = fn(x)
+        true_sync(o)
+
+    return timed_window(chunk, rtt, min_seconds, 4)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--quick", action="store_true",
+                    help="one size, one density, short windows")
+    ap.add_argument("--min-seconds", type=float, default=1.0)
+    args = ap.parse_args()
+
+    device = jax.devices()[0].device_kind.replace(" ", "_")
+    sizes = dict(list(SIZES.items())[:1]) if args.quick else SIZES
+    densities = DENSITIES[:1] if args.quick else DENSITIES
+    min_s = 0.3 if args.quick else args.min_seconds
+
+    rows = []
+    for label, n in sizes.items():
+        for rho in densities:
+            k = k_for_density(n, rho)
+            for method in METHODS:
+                try:
+                    sec, steps = time_method(method, n, k, min_s)
+                    err = None
+                except Exception as e:  # record, don't abort the sweep
+                    sec, steps, err = None, 0, f"{type(e).__name__}: {e}"
+                rows.append({
+                    "size": label, "n": n, "density": rho, "k": k,
+                    "method": method, "ms": (
+                        round(sec * 1e3, 4) if sec is not None else None),
+                    "steps_timed": steps, "error": err,
+                })
+                ms = f"{sec * 1e3:9.3f} ms" if sec is not None else "FAILED"
+                print(f"{label:16s} rho={rho:<6g} {method:10s} {ms}",
+                      flush=True)
+
+    result = {
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+        "pallas_interpret": jax.default_backend() != "tpu",
+        "rows": rows,
+    }
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "results", f"topk_bench_{device}.json",
+    )
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -164,21 +164,38 @@ _METHODS = {
     "threshold": lambda x, k: threshold_topk_abs(x, k),
 }
 
+# Above this N, "auto" switches from exact lax.top_k to lax.approx_max_k.
+# Measured on the real TPU v5e chip (benchmarks/results/
+# topk_bench_TPU_v5_lite.json, benchmarks/topk_bench.py to reproduce):
+#
+#     N      rho    exact    blockwise  threshold  approx   pallas
+#     272k   0.001  0.40 ms   0.37 ms    3.25 ms   0.16 ms  3.26 ms
+#     25.6M  0.001  75.4 ms  144.1 ms  319.0 ms    1.27 ms  309 ms
+#     61M    0.001  196  ms  952   ms  736   ms    3.32 ms  736 ms
+#
+# exact is fine at CIFAR scale but catastrophic at ImageNet scale (75 ms
+# against a 60 ms ResNet-50 train step); approx_max_k (the TPU-native
+# bitonic partial reduction, arXiv:2206.14286) is ~60x faster at the sizes
+# that matter. Its recall_target=0.95 slightly changes which elements are
+# selected — safe here because error feedback keeps every missed element
+# in the residual for the next step (the same argument that justifies
+# top-k sparsification itself, arXiv:1911.08772), and the gtopk tree merge
+# (merge_sparse_sets) stays EXACT, so replicas remain in lockstep. Force
+# --topk-method exact to reproduce the reference's exact-selection
+# semantics at any size.
+AUTO_APPROX_THRESHOLD = 1 << 20
+
 
 def select_topk(x: Array, k: int, method: str = "auto") -> Tuple[Array, Array]:
     """Dispatch on top-k strategy.
 
-    "auto" = "exact": measured on TPU v5e at N=25.6M, k=25.6k (ResNet-50 at
-    rho=1e-3), monolithic `lax.top_k` lowers to XLA's tuned TopK custom call
-    and runs in ~0.08 ms (~one HBM pass) — 850x faster than the two-stage
-    blockwise decomposition (212 ms), 4000x faster than threshold+compact
-    (315 ms, Pallas-counted or not), and 890x faster than `approx_max_k`
-    (71 ms). The decompositions exist for study/CPU and are NOT the TPU
-    production path; do not "optimize" auto away from exact without
-    re-measuring on hardware.
+    "auto" picks exact `lax.top_k` for small N (cost is noise there) and
+    `lax.approx_max_k` above AUTO_APPROX_THRESHOLD — see the measured
+    table above; do not change the policy without re-running
+    benchmarks/topk_bench.py on hardware.
     """
     if method == "auto":
-        method = "exact"
+        method = "exact" if x.shape[0] <= AUTO_APPROX_THRESHOLD else "approx"
     if method == "pallas":
         from gtopkssgd_tpu.ops.pallas_topk import pallas_topk_abs
 
