@@ -1,0 +1,98 @@
+"""Deterministically (re)generate the committed real-format data fixtures.
+
+The fixtures prove the REAL-data parsing paths (pickle batches, ImageFolder
+JPEGs, PTB text, wav + manifest) actually parse their formats — every other
+test runs synthetic. They are tiny (a few hundred KB total) and committed;
+this script documents exactly how they were made and lets them be rebuilt:
+
+    python tests/fixtures/make_fixtures.py
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RNG = np.random.default_rng(1234)
+
+
+def make_cifar():
+    """8 images per batch file, standard cifar-10-batches-py pickle layout
+    (uint8 [N, 3072] row-major CHW + byte-keyed dict)."""
+    root = os.path.join(HERE, "cifar", "cifar-10-batches-py")
+    os.makedirs(root, exist_ok=True)
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        data = RNG.integers(0, 256, (8, 3072), dtype=np.uint8)
+        labels = RNG.integers(0, 10, 8).tolist()
+        with open(os.path.join(root, name), "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels,
+                         b"batch_label": name.encode()}, f)
+
+
+def make_ptb():
+    """Tiny word-level corpus, one sentence per line (the loader maps
+    newline -> <eos>). Train repeats a small vocabulary so the vocab builder
+    and <unk> mapping are both exercised (valid/test contain an OOV word)."""
+    root = os.path.join(HERE, "ptb")
+    os.makedirs(root, exist_ok=True)
+    sents = [
+        "the quick brown fox jumps over the lazy dog",
+        "a stitch in time saves nine",
+        "all that glitters is not gold",
+    ]
+    with open(os.path.join(root, "ptb.train.txt"), "w") as f:
+        for i in range(8):
+            f.write(sents[i % 3] + "\n")
+    with open(os.path.join(root, "ptb.valid.txt"), "w") as f:
+        f.write("the quick zebra jumps over gold\n" * 4)
+    with open(os.path.join(root, "ptb.test.txt"), "w") as f:
+        f.write("a lazy fox saves the dog\n" * 4)
+
+
+def make_an4():
+    """Two 0.5 s 16 kHz mono wavs (distinct tones + noise), transcripts,
+    and train/val manifests with manifest-relative paths."""
+    import scipy.io.wavfile as wavfile
+
+    root = os.path.join(HERE, "an4")
+    os.makedirs(root, exist_ok=True)
+    sr = 16000
+    t = np.arange(int(0.5 * sr)) / sr
+    for name, freq, text in [("hello", 440.0, "HELLO"),
+                             ("world", 880.0, "WORLD")]:
+        audio = 0.4 * np.sin(2 * np.pi * freq * t)
+        audio += 0.05 * RNG.standard_normal(t.shape)
+        wavfile.write(os.path.join(root, f"{name}.wav"), sr,
+                      (audio * 32767).astype(np.int16))
+        with open(os.path.join(root, f"{name}.txt"), "w") as f:
+            f.write(text + "\n")
+    with open(os.path.join(root, "an4_train_manifest.csv"), "w") as f:
+        f.write("hello.wav,hello.txt\nworld.wav,world.txt\n")
+    with open(os.path.join(root, "an4_val_manifest.csv"), "w") as f:
+        f.write("world.wav,world.txt\nhello.wav,hello.txt\n")
+
+
+def make_imagenet():
+    """2 classes x 3 train (+2 val) tiny JPEGs in ImageFolder layout."""
+    from PIL import Image
+
+    root = os.path.join(HERE, "imagenet")
+    for split, n in (("train", 3), ("val", 2)):
+        for wnid in ("n01440764", "n01443537"):
+            d = os.path.join(root, split, wnid)
+            os.makedirs(d, exist_ok=True)
+            for i in range(n):
+                arr = RNG.integers(0, 256, (48, 64, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(
+                    os.path.join(d, f"img_{i}.jpg"), quality=90)
+
+
+if __name__ == "__main__":
+    make_cifar()
+    make_ptb()
+    make_an4()
+    make_imagenet()
+    print("fixtures written under", HERE)
