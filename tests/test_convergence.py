@@ -20,7 +20,7 @@ from gtopkssgd_tpu.parallel import make_mesh
 PDEV, BATCH, STEPS = 4, 8, 40
 
 
-def run_mode(mode, density, seed=0):
+def run_mode(mode, density, seed=0, steps=STEPS):
     model, spec = get_model("resnet20")
     rng = jax.random.PRNGKey(seed)
     variables = model.init({"params": rng}, jnp.zeros((1, 32, 32, 3)))
@@ -56,7 +56,7 @@ def run_mode(mode, density, seed=0):
     ))
     opt_state = jax.jit(tx.init)(params)
     losses = []
-    for _ in range(STEPS):
+    for _ in range(steps):
         params, bstats, opt_state, loss = fn(params, bstats, opt_state, X, Y)
         losses.append(float(loss))
     return losses
@@ -81,3 +81,29 @@ def test_gtopk_tracks_dense(dense_losses):
 def test_allgather_tracks_dense(dense_losses):
     dgc = run_mode("allgather", 0.01)
     assert dgc[-1] < 0.5 * dgc[0], dgc[::10]
+
+
+def test_gtopk_rho001_long_horizon():
+    """The paper's operating point (rho=0.001, k=273 of 272k) over a long
+    horizon. Calibrated on this exact setup (seed-pinned, CPU): the 300-step
+    loss curve is [2.96, 2.25, 0.79, 0.21, 0.061, 0.018] at steps
+    [0,50,...,250] with final 0.0069 = 0.0023x initial — the thresholds
+    below carry >=7x margin over those measurements while still requiring
+    real convergence (the round-1 criterion of 0.5x initial would pass
+    after <100 of the 300 steps).
+
+    Why NOT the "gtopk final <= 1.2x dense final" form: on this overfit
+    micro-task dense reaches 1.5e-4 (pure memorization); ratio-to-dense at
+    the asymptote measures memorization speed, not tracking. And why there
+    is no disable-repair ablation: calibration showed the sign of the
+    repair effect flips with regime (no-repair converged FASTER here at
+    rho=0.001 and on an anisotropic least-squares testbed, slower at other
+    settings) — short-horizon loss is not a reliable detector of the
+    repair path. Repair's contract (rejected mass returns to the residual,
+    bit-exactly) is pinned deterministically in
+    tests/test_compression.py::test_repair_returns_rejected_mass and the
+    optimizer-level mass-conservation invariant instead.
+    """
+    gtopk = run_mode("gtopk", 0.001, steps=300)
+    assert gtopk[150] < 0.5 * gtopk[0], gtopk[::25]
+    assert gtopk[-1] < 0.05 * gtopk[0], gtopk[::25]
