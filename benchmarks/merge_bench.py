@@ -1,0 +1,193 @@
+"""Sparse merge-accumulate cost on real hardware — the evidence for/against
+a fused Pallas merge kernel.
+
+SURVEY.md §2 (native table) and §7 step 6 name a "sparse merge-accumulate"
+kernel as on the critical path of every gTop-k tree round (the reference did
+this merge host-side in numpy inside allreducer.py::gtopk_sparse_allreduce).
+The TPU rebuild's per-round merge is `ops.topk.merge_sparse_sets` — an XLA
+program (concat 2k -> argsort by index -> adjacent duplicate sum -> top_k).
+This benchmark measures, at the reference's real (N, k) operating points:
+
+  * `merge`       — merge_sparse_sets itself, one tree round's on-device cost;
+  * `merge_chain` — log2(32) = 5 chained merges, a whole 32-worker tree's
+                    merge work as XLA sees it (collectives excluded — one
+                    chip — so this is the pure compute side of the tree);
+  * `merge_argsort_topk` — the round-1 formulation (argsort + jnp.take
+                    gathers, lax.top_k reselect), kept as the measured
+                    justification for the carried-sort rewrite;
+  * `dense_scatter` — the naive alternative (scatter both sets into a dense
+                    f32[N] + exact top_k over N), to show why the sort-based
+                    sparse formulation was chosen.
+
+The verdict this artifact encodes: whether the XLA merge is already cheap
+relative to its train step (ResNet-50's measured fused step is ~55-65 ms at
+batch 128 — bench.py), i.e. whether a hand-fused Pallas merge kernel could
+buy anything measurable.
+
+Run:  python -m benchmarks.merge_bench [--out PATH] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+
+from gtopkssgd_tpu.ops import merge_sparse_sets, scatter_add_dense, topk_abs
+from gtopkssgd_tpu.ops.topk import k_for_density
+from gtopkssgd_tpu.utils import (
+    sync_round_trip_seconds,
+    timed_window,
+    true_sync,
+)
+
+SIZES = {
+    "resnet20-270k": 272_474,
+    "resnet50-25.6M": 25_557_032,
+    "vgg16-61M": 61_090_496,
+}
+DENSITIES = (0.001, 0.01)
+CHAIN_ROUNDS = 5  # log2(32): the paper's cluster size
+
+
+def _random_sets(n: int, k: int, count: int):
+    """`count` distinct sparse sets with disjoint-ish random indices —
+    the honest case for the merge (round-1 lesson: replicated inputs are
+    the duplicate-heavy cheapest case)."""
+    sets = []
+    for i in range(count):
+        kk = jax.random.PRNGKey(i)
+        idx = jax.random.randint(kk, (k,), 0, n, jnp.int32)
+        vals = jax.random.normal(jax.random.fold_in(kk, 1), (k,), jnp.float32)
+        sets.append((vals, idx))
+    return sets
+
+
+def _time(fn, args, min_seconds: float):
+    out = fn(*args)
+    rtt = sync_round_trip_seconds(out)
+
+    def chunk(c):
+        o = out
+        for _ in range(c):
+            o = fn(*args)
+        true_sync(o)
+
+    return timed_window(chunk, rtt, min_seconds, 4)
+
+
+def time_merge(n: int, k: int, min_seconds: float):
+    (va, ia), (vb, ib) = _random_sets(n, k, 2)
+    fn = jax.jit(lambda a, b, c, d: merge_sparse_sets(a, b, c, d, k, n))
+    return _time(fn, (va, ia, vb, ib), min_seconds)
+
+
+def time_merge_chain(n: int, k: int, min_seconds: float):
+    sets = _random_sets(n, k, CHAIN_ROUNDS + 1)
+
+    def chain(first, rest):
+        v, i = first
+        for rv, ri in rest:
+            v, i = merge_sparse_sets(v, i, rv, ri, k, n)
+        return v, i
+
+    fn = jax.jit(chain)
+    return _time(fn, (sets[0], sets[1:]), min_seconds)
+
+
+def _merge_argsort_topk(va, ia, vb, ib, k, n):
+    """Round-1 merge formulation, retained for comparison only."""
+    from jax import lax
+
+    cat_idx = jnp.concatenate([ia, ib])
+    cat_val = jnp.concatenate([va, vb])
+    order = jnp.argsort(cat_idx)
+    si = jnp.take(cat_idx, order)
+    sv = jnp.take(cat_val, order)
+    dup = jnp.concatenate([jnp.zeros((1,), bool), si[1:] == si[:-1]])
+    next_dup = jnp.concatenate([dup[1:], jnp.zeros((1,), bool)])
+    summed = sv + jnp.where(next_dup, jnp.roll(sv, -1), 0.0)
+    merged_val = jnp.where(dup, 0.0, summed)
+    merged_idx = jnp.where(dup, n, si).astype(jnp.int32)
+    _, sel = lax.top_k(jnp.abs(merged_val), k)
+    return jnp.take(merged_val, sel), jnp.take(merged_idx, sel)
+
+
+def time_merge_argsort(n: int, k: int, min_seconds: float):
+    (va, ia), (vb, ib) = _random_sets(n, k, 2)
+    fn = jax.jit(lambda a, b, c, d: _merge_argsort_topk(a, b, c, d, k, n))
+    return _time(fn, (va, ia, vb, ib), min_seconds)
+
+
+def time_dense_scatter(n: int, k: int, min_seconds: float):
+    (va, ia), (vb, ib) = _random_sets(n, k, 2)
+
+    def dense_merge(va, ia, vb, ib):
+        d = scatter_add_dense(n, ia, va) + scatter_add_dense(n, ib, vb)
+        return topk_abs(d, k)
+
+    fn = jax.jit(dense_merge)
+    return _time(fn, (va, ia, vb, ib), min_seconds)
+
+
+VARIANTS = {
+    "merge": time_merge,
+    "merge_chain5": time_merge_chain,
+    "merge_argsort_topk": time_merge_argsort,
+    "dense_scatter": time_dense_scatter,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--min-seconds", type=float, default=1.0)
+    args = ap.parse_args()
+
+    device = jax.devices()[0].device_kind.replace(" ", "_")
+    sizes = dict(list(SIZES.items())[:1]) if args.quick else SIZES
+    densities = DENSITIES[:1] if args.quick else DENSITIES
+    min_s = 0.3 if args.quick else args.min_seconds
+
+    rows = []
+    for label, n in sizes.items():
+        for rho in densities:
+            k = k_for_density(n, rho)
+            for name, timer in VARIANTS.items():
+                try:
+                    sec, steps = timer(n, k, min_s)
+                    err = None
+                except Exception as e:  # record, don't abort the sweep
+                    sec, steps, err = None, 0, f"{type(e).__name__}: {e}"
+                rows.append({
+                    "size": label, "n": n, "density": rho, "k": k,
+                    "variant": name, "ms": (
+                        round(sec * 1e3, 4) if sec is not None else None),
+                    "steps_timed": steps, "error": err,
+                })
+                ms = f"{sec * 1e3:9.3f} ms" if sec is not None else "FAILED"
+                print(f"{label:16s} rho={rho:<6g} {name:14s} {ms}",
+                      flush=True)
+
+    result = {
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+        "chain_rounds": CHAIN_ROUNDS,
+        "rows": rows,
+    }
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "results", f"merge_bench_{device}.json",
+    )
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

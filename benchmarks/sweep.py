@@ -39,6 +39,9 @@ def main():
     ap.add_argument("--topk-method", default="auto")
     ap.add_argument("--breakdown", action="store_true",
                     help="per-phase decomposition instead of fused step")
+    ap.add_argument("--hier-ici", type=int, default=0,
+                    help="> 0: also sweep gtopk_hier with this many devices "
+                         "per ICI slice")
     ap.add_argument("--out", default=None, help="append JSONL here too")
     args = ap.parse_args()
 
@@ -46,11 +49,14 @@ def main():
         dnn=args.dnn, batch_size=args.batch_size, steps=args.steps,
         min_seconds=args.min_seconds, dtype=args.dtype,
         topk_method=args.topk_method,
+        hier_ici=max(1, args.hier_ici),
     )
     fh = open(args.out, "a") if args.out else None
     points = [("dense", 1.0)] + [("gtopk", d) for d in args.densities
                                  if d < 1.0]
     points += [("allgather", d) for d in args.densities if d < 1.0]
+    if args.hier_ici > 1:
+        points += [("gtopk_hier", d) for d in args.densities if d < 1.0]
     for mode, density in points:
         fn = measure_breakdown if args.breakdown else measure_throughput
         rec = fn(cfg, mode, density)

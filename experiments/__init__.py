@@ -86,6 +86,16 @@ EXPERIMENTS: Dict[str, Dict[str, Any]] = {
         _desc="BiLSTM-CTC/AN4, 4-worker gTop-k rho=0.001",
         _baseline="paper workload 6",
     ),
+    # --- TPU extension (NOT reference parity): hierarchical two-level ---
+    # Dense psum inside each 4-chip ICI slice, gTop-k across slices — the
+    # pod-scale idiom SURVEY.md §5 names for multislice/DCN runs.
+    "imagenet_resnet50_gtopk_hier": dict(
+        dnn="resnet50", batch_size=32, nworkers=16, compression="gtopk_hier",
+        hier_ici=4, density=0.001, max_epochs=90, dtype="bfloat16",
+        _desc="ResNet-50/ImageNet, 16 workers as 4 ICI slices x 4: dense "
+              "within slice, gTop-k across (TPU extension)",
+        _baseline="extension",
+    ),
 }
 
 # BASELINE.json config #5 (density sweep) is a benchmark, not a training

@@ -31,7 +31,7 @@ from jax.sharding import PartitionSpec as P
 
 from gtopkssgd_tpu.compression import get_compressor
 from gtopkssgd_tpu.models import get_model
-from gtopkssgd_tpu.modes import DENSE_MODES
+from gtopkssgd_tpu.modes import DENSE_MODES, HIER_MODES
 from gtopkssgd_tpu.optimizer import gtopk_sgd
 from gtopkssgd_tpu.ops import scatter_add_dense
 from gtopkssgd_tpu.parallel import (
@@ -56,6 +56,7 @@ class BenchConfig:
     dtype: str = "bfloat16"
     topk_method: str = "auto"
     nworkers: int = 0  # 0 = all devices
+    hier_ici: int = 1  # gtopk_hier: devices per ICI slice
 
 
 # Peak dense matmul throughput per chip (bf16), for MFU. Keys match
@@ -103,6 +104,7 @@ def _setup(cfg: BenchConfig, mode: Optional[str], density: float):
     tx = gtopk_sgd(
         0.1, momentum=0.9, compression=mode, density=density,
         topk_method=cfg.topk_method, axis_name="dp",
+        hier_ici_size=cfg.hier_ici if mode in HIER_MODES else 1,
     )
     return model, spec, variables, tx, shape
 
@@ -242,7 +244,10 @@ def measure_throughput(cfg: BenchConfig, mode: Optional[str],
             achieved / 1e12 if achieved is not None else None
         ),
         "mfu": (achieved / peak if achieved is not None and peak else None),
-        "comm_bytes_model": comm_bytes_per_step(mode, n, k, p),
+        "comm_bytes_model": comm_bytes_per_step(
+            mode, n, k, p,
+            ici_size=cfg.hier_ici if mode in HIER_MODES else 1,
+        ),
         "num_params": n,
         "nworkers": p,
     }
@@ -288,19 +293,34 @@ def measure_breakdown(cfg: BenchConfig, mode: Optional[str],
         acc = compressor.accumulate(flat, residual)
         return compressor.compress(acc)
 
-    def _sparse_body(v, i):
+    hier_ici = cfg.hier_ici if mode in HIER_MODES else 1
+
+    def _sparse_body(f, v, i):
+        # For the hierarchical mode both communication levels are charged to
+        # this phase: the dense within-slice psum on the flat gradient (ICI)
+        # plus the cross-slice tree on the sparse sets (DCN). The psum
+        # result must feed an OUTPUT or XLA dead-code-eliminates the whole
+        # level-1 collective; a scalar checksum keeps it live (one extra
+        # O(N) read — noise next to the psum itself).
+        live = jnp.zeros((1,), jnp.float32)
+        if hier_ici > 1:
+            from gtopkssgd_tpu.parallel import ici_dense_psum
+            f2 = ici_dense_psum(f[0], axis_name="dp", axis_size=p,
+                                ici_size=hier_ici)
+            live = f2.sum()[None]
         r, gi, _ = sparse_allreduce(
-            mode, v[0], i[0], k=k, n=n, axis_name="dp", axis_size=p
+            mode, v[0], i[0], k=k, n=n, axis_name="dp", axis_size=p,
+            ici_size=hier_ici,
         )
         if gi is None:
-            return r[None], jnp.zeros((1, 1), jnp.int32)
-        return r[None], gi[None]
+            return r[None], jnp.zeros((1, 1), jnp.int32), live[None]
+        return r[None], gi[None], live[None]
 
     # jit ONCE outside the timed call — rebuilding the jit per call would
     # time retracing, not the collective.
     comm_gtopk = jax.jit(jax.shard_map(
-        _sparse_body, mesh=mesh, in_specs=(P("dp"), P("dp")),
-        out_specs=(P("dp"), P("dp")), check_vma=False,
+        _sparse_body, mesh=mesh, in_specs=(P("dp"), P("dp"), P("dp")),
+        out_specs=(P("dp"), P("dp"), P("dp")), check_vma=False,
     ))
     comm_dense = jax.jit(jax.shard_map(
         lambda f: lax.psum(f[0], "dp")[None], mesh=mesh,
@@ -336,7 +356,8 @@ def measure_breakdown(cfg: BenchConfig, mode: Optional[str],
         idxs = jnp.stack([
             jax.random.randint(kk, idx.shape, 0, n, jnp.int32) for kk in keys
         ])
-        res["comm"] = _timeit(comm_gtopk, (valss, idxs), cfg.steps)
+        flats = jnp.broadcast_to(flat, (p,) + flat.shape)
+        res["comm"] = _timeit(comm_gtopk, (flats, valss, idxs), cfg.steps)
         dense_grad = scatter_add_dense(n, idx, vals)
     ja = jax.jit(apply_updates)
     res["apply"] = _timeit(ja, (params, dense_grad), cfg.steps)

@@ -43,10 +43,18 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--weight-decay", type=float, default=None)
     p.add_argument("--nesterov", action="store_true")
     p.add_argument("--compression", default=None,
-                   choices=["none", "dense", "gtopk", "allgather", "topk"],
+                   choices=["none", "dense", "gtopk", "allgather", "topk",
+                            "gtopk_hier"],
                    help="None/dense = psum baseline; gtopk = tree sparse "
-                        "allreduce; allgather/topk = DGC-style union")
+                        "allreduce; allgather/topk = DGC-style union; "
+                        "gtopk_hier = dense within ICI slice, gtopk across "
+                        "slices (set --hier-ici)")
     p.add_argument("--density", type=float, default=0.001)
+    p.add_argument("--hier-ici", type=int, default=1,
+                   help="gtopk_hier: devices per ICI slice (dense psum "
+                        "within each contiguous block of this many ranks, "
+                        "gTop-k hypercube across the nworkers/hier_ici "
+                        "slices)")
     p.add_argument("--topk-method", default="auto",
                    choices=["auto", "exact", "blockwise", "approx",
                             "threshold", "pallas"])
@@ -88,6 +96,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         nesterov=args.nesterov,
         compression=args.compression,
         density=args.density,
+        hier_ici=args.hier_ici,
         topk_method=args.topk_method,
         clip_grad_norm=args.clip_grad_norm,
         nsteps_update=args.nsteps_update,

@@ -8,6 +8,11 @@ table and silently missed by another.
 DENSE_MODES = (None, "none", "dense")
 GTOPK_MODES = ("gtopk",)
 ALLGATHER_MODES = ("allgather", "topk", "topkA", "topk_allgather")
+# Hierarchical two-level reduction (TPU extension, not reference parity —
+# SURVEY.md §5 "distributed communication backend" names it as the natural
+# TPU idiom): dense psum within an ICI slice, gTop-k hypercube across
+# slices (the DCN hop, where bandwidth is scarce and sparsity pays).
+HIER_MODES = ("gtopk_hier",)
 
-ALL_MODES = DENSE_MODES + GTOPK_MODES + ALLGATHER_MODES
-SPARSE_MODES = GTOPK_MODES + ALLGATHER_MODES
+ALL_MODES = DENSE_MODES + GTOPK_MODES + ALLGATHER_MODES + HIER_MODES
+SPARSE_MODES = GTOPK_MODES + ALLGATHER_MODES + HIER_MODES

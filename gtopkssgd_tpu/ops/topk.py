@@ -234,20 +234,35 @@ def merge_sparse_sets(
         both partners and its tie-breaking is deterministic.
 
     Returns (values, indices) of the merged set, descending by |value|.
+
+    Implementation note (measured on TPU v5e, benchmarks/merge_bench.py):
+    both stages are multi-operand `lax.sort` calls that carry the payload
+    through the sort instead of `argsort` + `jnp.take` — gathers are the
+    slow path on TPU, and even the final k-selection is faster as a
+    carried sort over the 2k candidates than as `lax.top_k` + two takes
+    (1.2 ms -> 0.28 ms per round at k=25.6e3; 15.6 ms -> 1.7 ms at
+    k=2.6e5). Stage-2 tie-breaking on equal |value| is stable over the
+    stage-1 canonical (index-sorted) order, i.e. lowest-index-first —
+    the same rule `lax.top_k` applies, so determinism across partners is
+    unchanged.
     """
     cat_idx = jnp.concatenate([idx_a, idx_b])
     cat_val = jnp.concatenate([vals_a, vals_b])
-    # Canonical order: sort by index; equal (duplicate) indices are adjacent.
-    order = jnp.argsort(cat_idx)
-    si = jnp.take(cat_idx, order)
-    sv = jnp.take(cat_val, order)
+    # Canonical order: sort by index, values carried through the sort;
+    # equal (duplicate) indices become adjacent.
+    si, sv = lax.sort((cat_idx, cat_val), num_keys=1, is_stable=True)
     dup = jnp.concatenate([jnp.zeros((1,), bool), si[1:] == si[:-1]])
     next_dup = jnp.concatenate([dup[1:], jnp.zeros((1,), bool)])
     summed = sv + jnp.where(next_dup, jnp.roll(sv, -1), 0.0)
     merged_val = jnp.where(dup, 0.0, summed)
     merged_idx = jnp.where(dup, n, si).astype(SENTINEL_DTYPE)
-    _, sel = lax.top_k(jnp.abs(merged_val), k)
-    return jnp.take(merged_val, sel), jnp.take(merged_idx, sel)
+    # Reselect: ascending sort on -|value| with (value, index) carried,
+    # then keep the first k.
+    _, out_val, out_idx = lax.sort(
+        (-jnp.abs(merged_val), merged_val, merged_idx),
+        num_keys=1, is_stable=True,
+    )
+    return out_val[:k], out_idx[:k]
 
 
 def scatter_add_dense(n: int, idx: Array, vals: Array, dtype=jnp.float32) -> Array:

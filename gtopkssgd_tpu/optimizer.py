@@ -43,9 +43,9 @@ from jax import lax
 from jax.flatten_util import ravel_pytree
 
 from gtopkssgd_tpu.compression import get_compressor
-from gtopkssgd_tpu.modes import ALL_MODES, DENSE_MODES
+from gtopkssgd_tpu.modes import ALL_MODES, DENSE_MODES, HIER_MODES
 from gtopkssgd_tpu.ops import scatter_add_dense
-from gtopkssgd_tpu.parallel import sparse_allreduce
+from gtopkssgd_tpu.parallel import ici_dense_psum, sparse_allreduce
 
 Array = jax.Array
 ScalarOrSchedule = Union[float, Callable[[Array], Array]]
@@ -73,6 +73,7 @@ def gtopk_sgd(
     clip_grad_norm: Optional[float] = None,
     axis_name: Optional[str] = "dp",
     axis_size: Optional[int] = None,
+    hier_ici_size: int = 1,
 ) -> optax.GradientTransformation:
     """Build the distributed gTop-k S-SGD gradient transformation.
 
@@ -94,10 +95,27 @@ def gtopk_sgd(
     derived from the bound mesh axis at trace time (``lax.axis_size``), so it
     cannot silently disagree with the mesh; ``axis_size``, if given, is only
     validated against it.
+
+    ``compression='gtopk_hier'`` enables the two-level TPU-idiom reduction
+    (not reference parity — SURVEY.md §5 design option): the raw gradient is
+    first dense-psum'd WITHIN each contiguous block of ``hier_ici_size``
+    devices (an ICI slice — cheap, high-bandwidth links), then error
+    feedback + top-k run on the slice-summed gradient and the gTop-k
+    hypercube runs only ACROSS the ``P / hier_ici_size`` slices (the DCN
+    hop, where sparsity pays). Every device of a slice computes identical
+    sets, so the per-device residual stays consistent automatically.
     """
     mode = compression
     if mode not in ALL_MODES:
         raise ValueError(f"unknown compression mode {mode!r}")
+    hier = mode in HIER_MODES
+    if hier_ici_size < 1:
+        raise ValueError(f"hier_ici_size must be >= 1, got {hier_ici_size}")
+    if hier_ici_size > 1 and not hier:
+        raise ValueError(
+            f"hier_ici_size={hier_ici_size} only applies to hierarchical "
+            f"modes {HIER_MODES}, not {mode!r}"
+        )
     if nesterov and not momentum:
         # torch.optim.SGD raises here too; silently running plain SGD while
         # the user believes Nesterov is active would be worse.
@@ -153,6 +171,19 @@ def gtopk_sgd(
             flat = flat * scale
 
         p = bound_axis_size()
+        if hier and p > 1:
+            if p % hier_ici_size != 0:
+                raise ValueError(
+                    f"axis size {p} not divisible by "
+                    f"hier_ici_size={hier_ici_size}"
+                )
+            # Level 1: dense sum within the ICI slice, BEFORE error feedback
+            # — the slice acts as one logical worker from here on, and all
+            # of its devices hold identical acc/top-k/residual.
+            flat = ici_dense_psum(
+                flat, axis_name=axis_name, axis_size=p,
+                ici_size=hier_ici_size,
+            )
         if dense_mode:
             reduced = lax.psum(flat, axis_name) if p > 1 else flat
             dense = reduced / p
@@ -166,6 +197,7 @@ def gtopk_sgd(
                 result, gidx, needs_repair = sparse_allreduce(
                     mode, vals, idx, k=compressor.k(n), n=n,
                     axis_name=axis_name, axis_size=p,
+                    ici_size=hier_ici_size if hier else 1,
                 )
                 if needs_repair:  # gtopk: sparse (gvals, gidx) + repair
                     residual = compressor.repair(residual, vals, idx, gidx)

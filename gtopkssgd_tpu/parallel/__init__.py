@@ -11,6 +11,8 @@ baseline, `psum` the dense baseline. No threads, no host staging, no D2H/H2D.
 from gtopkssgd_tpu.parallel.collectives import (
     dense_allreduce,
     gtopk_allreduce,
+    hier_gtopk_allreduce,
+    ici_dense_psum,
     topk_allgather,
     sparse_allreduce,
     comm_bytes_per_step,
@@ -20,6 +22,8 @@ from gtopkssgd_tpu.parallel.mesh import make_mesh, dp_axis
 __all__ = [
     "dense_allreduce",
     "gtopk_allreduce",
+    "hier_gtopk_allreduce",
+    "ici_dense_psum",
     "topk_allgather",
     "sparse_allreduce",
     "comm_bytes_per_step",

@@ -51,6 +51,7 @@ from gtopkssgd_tpu.modes import (  # noqa: E402  (re-export)
     ALLGATHER_MODES,
     DENSE_MODES,
     GTOPK_MODES,
+    HIER_MODES,
 )
 
 
@@ -119,6 +120,126 @@ def _allgather_reselect(
     return gvals, gidx
 
 
+def ici_dense_psum(x: Array, *, axis_name: str, axis_size: int,
+                   ici_size: int) -> Array:
+    """Dense allreduce WITHIN each contiguous ICI slice (device r belongs to
+    slice r // ici_size — contiguity matters: make_mesh lays ranks out along
+    the torus, so a contiguous block of ici_size ranks is ICI-adjacent and
+    this traffic rides ICI links only).
+
+    Level 1 of the hierarchical mode: after this, every device of a slice
+    holds the identical slice-summed tensor, so the slice behaves as one
+    logical gTop-k worker for the cross-slice level.
+
+    Built from `lax.ppermute` rounds because shard_map's psum does not
+    support axis_index_groups. Determinism contract: every device of a
+    slice must end up with the BITWISE-identical sum — the hierarchical
+    mode compresses the result with top-k, which is discontinuous, so a
+    1-ulp difference at the k-th magnitude would make slice members select
+    different index sets and silently diverge. Recursive doubling gives
+    this for free (each round adds two operands that are identical up to
+    commutation, and IEEE addition is commutative); for non-power-of-two
+    slice sizes the extra offsets are folded into the largest
+    power-of-two block first, hypercubed there, and the result broadcast
+    back — every device's sum is built with the same association. (A
+    rotate-and-accumulate ring would sum in a different order on each
+    device: not bitwise safe.)
+    """
+    if ici_size <= 1:
+        return x
+    p, s = axis_size, ici_size
+
+    def _hypercube(x, width):
+        # recursive doubling among slice offsets [0, width); offsets
+        # outside receive zeros and must keep their value via the mask
+        r = 1
+        j = lax.axis_index(axis_name) % s
+        while r < width:
+            perm = [
+                (i, (i // s) * s + ((i % s) ^ r))
+                for i in range(p) if (i % s) < width
+            ]
+            recv = lax.ppermute(x, axis_name, perm)
+            x = jnp.where(j < width, x + recv, x) if width < s else x + recv
+            r <<= 1
+        return x
+
+    if _is_pow2(s):
+        return _hypercube(x, s)
+    m = 1 << (s.bit_length() - 1)  # largest power of two <= s
+    e = s - m                      # extra offsets [m, s)
+    j = lax.axis_index(axis_name) % s
+    # fold extras down: offset m+t sends to offset t
+    perm = [(i, i - m) for i in range(p) if (i % s) >= m]
+    recv = lax.ppermute(x, axis_name, perm)
+    x = jnp.where(j < e, x + recv, x)
+    x = _hypercube(x, m)
+    # broadcast the completed sum back up to the extras
+    perm = [(i, i + m) for i in range(p) if (i % s) < e]
+    recv = lax.ppermute(x, axis_name, perm)
+    return jnp.where(j >= m, recv, x)
+
+
+def hier_gtopk_allreduce(
+    vals: Array,
+    idx: Array,
+    *,
+    k: int,
+    n: int,
+    axis_name: str,
+    axis_size: int,
+    ici_size: int,
+) -> Tuple[Array, Array]:
+    """Cross-slice gTop-k hypercube (level 2 of the hierarchical mode).
+
+    Inputs are per-device local top-k sets that are already identical within
+    each slice (computed from the ici_dense_psum'd gradient), so the tree
+    only needs to run over the `n_slices = axis_size / ici_size` slice
+    index.  Every device participates (SPMD): at round r, device
+    `(s, j)` exchanges with `(s XOR 2^r, j)` — i.e. flat-rank partner
+    `(s ^ bit) * ici_size + j` — so each intra-slice offset j runs its own
+    redundant-but-identical copy of the tree and no device idles.  Non-pow2
+    slice counts fall back to a grouped allgather + reselect (exact sparse
+    sum over the slice representatives), mirroring gtopk_allreduce's
+    ragged-P fallback.
+    """
+    n_slices = axis_size // ici_size
+    if n_slices == 1:
+        return vals, idx
+    if not _is_pow2(n_slices):
+        # Ragged slice count: gather ALL P sets in identical rank order
+        # (full all_gather — the grouped variant is unavailable under
+        # shard_map), keep one representative row per slice, and
+        # scatter-add them in the same canonical slice order on every
+        # device before the exact reselect. Every device then runs the
+        # identical reduction on identical data -> bitwise-identical
+        # result everywhere. (A per-slice ring would fold the dense sum
+        # in a different order on each slice: non-associative float adds
+        # can differ by ulps, and top-k is discontinuous, so slices could
+        # silently select different global sets.) Comm is O(k P), same
+        # class as the flat non-pow2 fallback.
+        all_vals = lax.all_gather(vals, axis_name)          # [P, k]
+        all_idx = lax.all_gather(idx, axis_name)
+        rep_vals = all_vals[::ici_size].reshape(-1)         # [n_slices*k]
+        rep_idx = all_idx[::ici_size].reshape(-1)
+        dense = scatter_add_dense(n, rep_idx, rep_vals)
+        gvals, gidx = topk_abs(dense, k)
+        empty = gvals == 0.0
+        gidx = jnp.where(empty, n, gidx).astype(jnp.int32)
+        return gvals, gidx
+    rounds = int(math.log2(n_slices))
+    for r in range(rounds):
+        bit = 1 << r
+        perm = [
+            (i, ((i // ici_size) ^ bit) * ici_size + (i % ici_size))
+            for i in range(axis_size)
+        ]
+        pvals = lax.ppermute(vals, axis_name, perm)
+        pidx = lax.ppermute(idx, axis_name, perm)
+        vals, idx = merge_sparse_sets(vals, idx, pvals, pidx, k, n)
+    return vals, idx
+
+
 def topk_allgather(
     vals: Array,
     idx: Array,
@@ -152,21 +273,31 @@ def sparse_allreduce(
     n: int,
     axis_name: str,
     axis_size: int,
+    ici_size: int = 1,
 ) -> Tuple[Array, Array, bool]:
     """Mode dispatch preserving the reference's L2/L1 boundary.
 
     Returns (result, gidx, needs_repair):
-      * 'gtopk'     -> result = gvals f32[k], gidx = i32[k], True.
-      * 'allgather' -> result = the dense summed update f32[n], gidx = None,
-                       False (the union of P local sets has variable size up
-                       to k*P, so no fixed-k sparse return shape exists; no
-                       repair because every local pick is applied).
+      * 'gtopk'      -> result = gvals f32[k], gidx = i32[k], True.
+      * 'gtopk_hier' -> same shapes; the tree runs over slices only (the
+                        caller must have ici_dense_psum'd the gradient
+                        BEFORE compression so within-slice sets agree).
+      * 'allgather'  -> result = the dense summed update f32[n], gidx = None,
+                        False (the union of P local sets has variable size up
+                        to k*P, so no fixed-k sparse return shape exists; no
+                        repair because every local pick is applied).
     This is the one place the return shape differs across modes; the
     distributed optimizer branches on `gidx is None`.
     """
     if mode in GTOPK_MODES:
         gvals, gidx = gtopk_allreduce(
             vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size
+        )
+        return gvals, gidx, True
+    if mode in HIER_MODES:
+        gvals, gidx = hier_gtopk_allreduce(
+            vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size,
+            ici_size=ici_size,
         )
         return gvals, gidx, True
     if mode in ALLGATHER_MODES:
@@ -177,15 +308,27 @@ def sparse_allreduce(
     raise ValueError(f"unknown sparse allreduce mode {mode!r}")
 
 
-def comm_bytes_per_step(mode: str, n: int, k: int, p: int) -> int:
+def comm_bytes_per_step(mode: str, n: int, k: int, p: int,
+                        ici_size: int = 1) -> int:
     """Per-device communication volume model (paper §3 complexity table):
     gtopk O(k log P), allgather O(k P), dense O(N). 8 bytes per (f32, i32)
     element pair; dense counts 4-byte f32 once per element (ring allreduce
-    moves ~2N elements, we report the N model like the paper)."""
+    moves ~2N elements, we report the N model like the paper).
+
+    'gtopk_hier' reports the two levels summed: a dense O(N) within the
+    slice (which rides ICI — fast links, usually not the bottleneck the
+    model is meant to expose) plus the sparse O(k log(P/ici)) across
+    slices (the DCN hop the hierarchy exists to thin out)."""
     if mode in GTOPK_MODES:
         if not _is_pow2(p):
             return 8 * k * p
         return 8 * k * max(1, int(math.log2(p)))
+    if mode in HIER_MODES:
+        n_slices = max(1, p // max(1, ici_size))
+        sparse = (8 * k * int(math.log2(n_slices)) if _is_pow2(n_slices)
+                  else 8 * k * p)  # ragged: full all_gather fallback
+        dense = 4 * n if ici_size > 1 else 0
+        return dense + sparse
     if mode in ALLGATHER_MODES:
         return 8 * k * p
     if mode in DENSE_MODES:

@@ -67,7 +67,10 @@ class TrainConfig:
     weight_decay: Optional[float] = None  # default per dataset
     nesterov: bool = False
     compression: Optional[str] = None     # None/'dense'|'gtopk'|'allgather'
+                                          # |'gtopk_hier' (TPU extension)
     density: float = 0.001
+    hier_ici: int = 1              # gtopk_hier: devices per ICI slice (dense
+                                   # psum within, gtopk across slices)
     topk_method: str = "auto"
     clip_grad_norm: Optional[float] = None  # default: LSTMs clip (ref §3.4)
     nsteps_update: int = 1
@@ -166,6 +169,7 @@ class Trainer:
             topk_method=cfg.topk_method,
             clip_grad_norm=cfg.clip_grad_norm,
             axis_name="dp" if self.p > 1 else None,
+            hier_ici_size=cfg.hier_ici,
         )
         self.state, self.carry = self._init_state()
         self._train_step = self._build_train_step()

@@ -1,0 +1,241 @@
+"""Hierarchical ICI-dense / DCN-gtopk mode vs numpy oracles, 8-way.
+
+The hierarchical two-level reduction is a TPU-idiom EXTENSION, not
+reference parity (SURVEY.md §5 names it as the natural design option for
+pod-scale runs: dense psum inside an ICI slice where bandwidth is cheap,
+gTop-k across slices where the DCN hop makes sparsity pay). Semantics
+contract tested here: `gtopk_hier` over P devices in slices of size S is
+EXACTLY `gtopk` over the P/S slice-sum "super workers".
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from gtopkssgd_tpu.parallel import (
+    comm_bytes_per_step,
+    hier_gtopk_allreduce,
+    ici_dense_psum,
+    make_mesh,
+)
+from tests.test_collectives import make_local_sets, np_gtopk, np_topk
+
+PDEV = 8
+K = 8
+N = 300
+
+
+def _run_hier(vals, idxs, *, p, k, n, ici):
+    def body(v, i):
+        gv, gi = hier_gtopk_allreduce(
+            v[0], i[0], k=k, n=n, axis_name="dp", axis_size=p, ici_size=ici
+        )
+        return gv[None], gi[None]
+
+    mesh = make_mesh(p)
+    gv, gi = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P("dp"), P("dp")),
+            out_specs=(P("dp"), P("dp")),
+        )
+    )(jnp.asarray(vals), jnp.asarray(idxs))
+    return np.asarray(gv), np.asarray(gi)
+
+
+def _dense_of(vals, idxs, n):
+    out = np.zeros(n + 1, np.float32)
+    np.add.at(out, idxs, vals)
+    return out[:n]
+
+
+def test_ici_dense_psum_slice_sums(rng):
+    x = rng.standard_normal((PDEV, 17)).astype(np.float32)
+
+    def body(v):
+        return ici_dense_psum(v, axis_name="dp", axis_size=PDEV, ici_size=2)
+
+    mesh = make_mesh(PDEV)
+    out = np.asarray(jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    )(jnp.asarray(x)))
+    for s in range(PDEV // 2):
+        want = x[2 * s] + x[2 * s + 1]
+        np.testing.assert_allclose(out[2 * s], want, rtol=1e-6)
+        np.testing.assert_allclose(out[2 * s + 1], want, rtol=1e-6)
+
+
+@pytest.mark.parametrize("p,ici", [(8, 4), (6, 2), (6, 3), (5, 5)])
+def test_ici_dense_psum_bitwise_identical_within_slice(rng, p, ici):
+    """Determinism contract: slice members must hold the BITWISE-identical
+    sum (top-k is discontinuous; a 1-ulp difference would let devices of
+    one slice compress different index sets and silently diverge). Covers
+    the power-of-two hypercube and the non-pow2 fold-in path."""
+    x = rng.standard_normal((p, 33)).astype(np.float32)
+
+    def body(v):
+        return ici_dense_psum(v, axis_name="dp", axis_size=p, ici_size=ici)
+
+    mesh = make_mesh(p)
+    out = np.asarray(jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    )(jnp.asarray(x)))
+    for s in range(p // ici):
+        grp = slice(s * ici, (s + 1) * ici)
+        np.testing.assert_allclose(
+            out[grp][0], x[grp].astype(np.float64).sum(0), rtol=1e-5,
+            atol=1e-6,
+        )
+        for j in range(1, ici):
+            np.testing.assert_array_equal(out[s * ici], out[s * ici + j])
+
+
+@pytest.mark.parametrize("ici", [1, 2, 4])
+def test_hier_tree_matches_slice_level_oracle(rng, ici):
+    """With within-slice-identical inputs (the optimizer guarantees this via
+    ici_dense_psum before compression), the cross-slice tree must equal the
+    plain recursive-doubling oracle over the n_slices distinct sets."""
+    n_slices = PDEV // ici
+    svals, sidxs = make_local_sets(rng, p=n_slices, k=K, n=N)
+    # replicate each slice's set to all of its devices
+    vals = np.repeat(svals, ici, axis=0)
+    idxs = np.repeat(sidxs, ici, axis=0)
+
+    gv, gi = _run_hier(vals, idxs, p=PDEV, k=K, n=N, ici=ici)
+
+    # identical on every device (including across slices)
+    for d in range(1, PDEV):
+        np.testing.assert_array_equal(gi[0], gi[d])
+        np.testing.assert_allclose(gv[0], gv[d], rtol=1e-6)
+
+    if n_slices == 1:
+        np.testing.assert_array_equal(gi[0], sidxs[0])
+        np.testing.assert_allclose(gv[0], svals[0], rtol=1e-6)
+        return
+    ov, oi = np_gtopk(list(svals), list(sidxs), K, N)
+    np.testing.assert_allclose(
+        _dense_of(gv[0], gi[0], N), _dense_of(ov[0], oi[0], N),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_hier_non_pow2_slice_count_fallback(rng):
+    """p=6, ici=2 -> 3 slices: grouped allgather + exact reselect."""
+    p, ici, k, n = 6, 2, 5, 100
+    n_slices = p // ici
+    svals, sidxs = make_local_sets(rng, p=n_slices, k=k, n=n)
+    vals = np.repeat(svals, ici, axis=0)
+    idxs = np.repeat(sidxs, ici, axis=0)
+
+    gv, gi = _run_hier(vals, idxs, p=p, k=k, n=n, ici=ici)
+    dense = np.zeros(n, np.float64)
+    for s in range(n_slices):
+        np.add.at(dense, sidxs[s], svals[s])
+    ov, oi = np_topk(dense.astype(np.float32), k)
+    want = np.zeros(n, np.float32)
+    want[oi] = ov
+    for d in range(p):
+        np.testing.assert_allclose(
+            _dense_of(gv[d], gi[d], n), want, rtol=1e-5, atol=1e-6
+        )
+
+
+def test_optimizer_hier_equals_gtopk_over_slice_sums(rng):
+    """End-to-end contract: gtopk_hier on 8 devices (ici=2) produces the
+    same global sparse set and per-slice residuals as plain gtopk on 4
+    devices whose local gradients are the slice sums. Updates differ only
+    by the 1/P averaging factor (1/8 vs 1/4), which we scale out."""
+    from gtopkssgd_tpu.optimizer import gtopk_sgd
+
+    n_param = 64
+    density = 0.125  # k = 8
+    grads8 = rng.standard_normal((PDEV, n_param)).astype(np.float32)
+    grads4 = np.stack([
+        grads8[2 * s] + grads8[2 * s + 1] for s in range(4)
+    ])
+
+    def run(mode, p, grads, ici=1):
+        tx = gtopk_sgd(
+            1.0, momentum=0.0, weight_decay=0.0, compression=mode,
+            density=density, axis_name="dp", hier_ici_size=ici,
+        )
+        params = jnp.zeros((n_param,))
+        state0 = tx.init(params)
+        res0 = jnp.zeros((p,) + state0.residual.shape)
+
+        def body(g, res):
+            st = state0._replace(residual=res[0])
+            upd, st2 = tx.update(g[0], st, params)
+            return upd[None], st2.residual[None]
+
+        mesh = make_mesh(p)
+        upd, res = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                out_specs=(P("dp"), P("dp")), check_vma=False,
+            )
+        )(jnp.asarray(grads), res0)
+        return np.asarray(upd), np.asarray(res)
+
+    upd_h, res_h = run("gtopk_hier", PDEV, grads8, ici=2)
+    upd_p, res_p = run("gtopk", 4, grads4)
+
+    # updates: same sparse set, averaged over 8 vs 4 contributions
+    for d in range(PDEV):
+        np.testing.assert_allclose(
+            upd_h[d] * 8.0, upd_p[d // 2] * 4.0, rtol=1e-5, atol=1e-6
+        )
+    # residuals: per-slice, equal to the 4-way run's per-device residuals
+    for s in range(4):
+        np.testing.assert_allclose(res_h[2 * s], res_h[2 * s + 1], rtol=1e-6)
+        np.testing.assert_allclose(res_h[2 * s], res_p[s], rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_hier_rejects_bad_config():
+    from gtopkssgd_tpu.optimizer import gtopk_sgd
+
+    with pytest.raises(ValueError):
+        gtopk_sgd(0.1, compression="gtopk", hier_ici_size=2)
+    with pytest.raises(ValueError):
+        gtopk_sgd(0.1, compression="gtopk_hier", hier_ici_size=0)
+
+
+def test_comm_model_hier():
+    n, k = 10_000_000, 10_000
+    # 32 devices in slices of 4 -> 8 slices: dense O(N) on ICI + 3 sparse
+    # rounds on DCN.
+    assert comm_bytes_per_step("gtopk_hier", n, k, 32, ici_size=4) == (
+        4 * n + 8 * k * 3
+    )
+    # ici_size=1 degenerates to plain gtopk volume
+    assert comm_bytes_per_step("gtopk_hier", n, k, 32, ici_size=1) == (
+        comm_bytes_per_step("gtopk", n, k, 32)
+    )
+    # the DCN hop (what the hierarchy minimizes) is log2(P/ici) sparse
+    # rounds vs log2(P) for flat gtopk
+    dcn_hier = 8 * k * 3
+    dcn_flat = comm_bytes_per_step("gtopk", n, k, 32)
+    assert dcn_hier < dcn_flat
+
+
+def test_trainer_hier_one_step():
+    """Full train step with gtopk_hier over the 8-device mesh: runs, loss
+    finite, residual identical within each slice (the ici psum guarantee)."""
+    from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+    t = Trainer(TrainConfig(
+        dnn="resnet20", batch_size=2, nworkers=8, compression="gtopk_hier",
+        hier_ici=2, density=0.01, max_epochs=1, log_interval=1,
+        eval_batches=1,
+    ))
+    stats = t.train(2)
+    assert np.isfinite(stats["loss"])
+    res = np.asarray(
+        jax.device_get(t.state.opt_state.residual)
+    )
+    assert res.shape[0] == 8
+    assert np.abs(res).max() > 0  # error feedback is actually accumulating
+    for s in range(4):
+        np.testing.assert_allclose(res[2 * s], res[2 * s + 1], rtol=1e-6)
