@@ -295,32 +295,44 @@ def measure_breakdown(cfg: BenchConfig, mode: Optional[str],
 
     hier_ici = cfg.hier_ici if mode in HIER_MODES else 1
 
-    def _sparse_body(f, v, i):
-        # For the hierarchical mode both communication levels are charged to
-        # this phase: the dense within-slice psum on the flat gradient (ICI)
-        # plus the cross-slice tree on the sparse sets (DCN). The psum
-        # result must feed an OUTPUT or XLA dead-code-eliminates the whole
-        # level-1 collective; a scalar checksum keeps it live (one extra
-        # O(N) read — noise next to the psum itself).
-        live = jnp.zeros((1,), jnp.float32)
-        if hier_ici > 1:
-            from gtopkssgd_tpu.parallel import ici_dense_psum
-            f2 = ici_dense_psum(f[0], axis_name="dp", axis_size=p,
-                                ici_size=hier_ici)
-            live = f2.sum()[None]
+    def _sparse_tail(v, i):
         r, gi, _ = sparse_allreduce(
             mode, v[0], i[0], k=k, n=n, axis_name="dp", axis_size=p,
             ici_size=hier_ici,
         )
         if gi is None:
-            return r[None], jnp.zeros((1, 1), jnp.int32), live[None]
-        return r[None], gi[None], live[None]
+            return r[None], jnp.zeros((1, 1), jnp.int32)
+        return r[None], gi[None]
+
+    if hier_ici > 1:
+        # Hierarchical comm body: both communication levels are charged to
+        # this phase — the dense within-slice psum on the flat gradient
+        # (ICI) plus the cross-slice tree on the sparse sets (DCN). The
+        # psum result must feed an OUTPUT or XLA dead-code-eliminates the
+        # whole level-1 collective; a scalar checksum keeps it live (one
+        # extra O(N) read — noise next to the psum itself). Non-hier modes
+        # use the 2-arg body: threading the O(p*N) flats into their timed
+        # call would add a per-call reshard they never pay in production.
+        from gtopkssgd_tpu.parallel import ici_dense_psum
+
+        def _sparse_body(f, v, i):
+            f2 = ici_dense_psum(f[0], axis_name="dp", axis_size=p,
+                                ici_size=hier_ici)
+            r, gi = _sparse_tail(v, i)
+            return r, gi, f2.sum()[None, None]
+
+        comm_in_specs = (P("dp"), P("dp"), P("dp"))
+        comm_out_specs = (P("dp"), P("dp"), P("dp"))
+    else:
+        _sparse_body = _sparse_tail
+        comm_in_specs = (P("dp"), P("dp"))
+        comm_out_specs = (P("dp"), P("dp"))
 
     # jit ONCE outside the timed call — rebuilding the jit per call would
     # time retracing, not the collective.
     comm_gtopk = jax.jit(jax.shard_map(
-        _sparse_body, mesh=mesh, in_specs=(P("dp"), P("dp"), P("dp")),
-        out_specs=(P("dp"), P("dp"), P("dp")), check_vma=False,
+        _sparse_body, mesh=mesh, in_specs=comm_in_specs,
+        out_specs=comm_out_specs, check_vma=False,
     ))
     comm_dense = jax.jit(jax.shard_map(
         lambda f: lax.psum(f[0], "dp")[None], mesh=mesh,
@@ -356,8 +368,19 @@ def measure_breakdown(cfg: BenchConfig, mode: Optional[str],
         idxs = jnp.stack([
             jax.random.randint(kk, idx.shape, 0, n, jnp.int32) for kk in keys
         ])
-        flats = jnp.broadcast_to(flat, (p,) + flat.shape)
-        res["comm"] = _timeit(comm_gtopk, (flats, valss, idxs), cfg.steps)
+        if hier_ici > 1:
+            # Pre-shard the per-device flats over 'dp' so the timed window
+            # measures the collective, not a host->device reshard.
+            from jax.sharding import NamedSharding
+
+            flats = jax.device_put(
+                jnp.broadcast_to(flat, (p,) + flat.shape),
+                NamedSharding(mesh, P("dp")),
+            )
+            res["comm"] = _timeit(
+                comm_gtopk, (flats, valss, idxs), cfg.steps)
+        else:
+            res["comm"] = _timeit(comm_gtopk, (valss, idxs), cfg.steps)
         dense_grad = scatter_add_dense(n, idx, vals)
     ja = jax.jit(apply_updates)
     res["apply"] = _timeit(ja, (params, dense_grad), cfg.steps)

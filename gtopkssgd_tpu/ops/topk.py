@@ -235,16 +235,20 @@ def merge_sparse_sets(
 
     Returns (values, indices) of the merged set, descending by |value|.
 
-    Implementation note (measured on TPU v5e, benchmarks/merge_bench.py):
-    both stages are multi-operand `lax.sort` calls that carry the payload
-    through the sort instead of `argsort` + `jnp.take` — gathers are the
-    slow path on TPU, and even the final k-selection is faster as a
-    carried sort over the 2k candidates than as `lax.top_k` + two takes
-    (1.2 ms -> 0.28 ms per round at k=25.6e3; 15.6 ms -> 1.7 ms at
-    k=2.6e5). Stage-2 tie-breaking on equal |value| is stable over the
-    stage-1 canonical (index-sorted) order, i.e. lowest-index-first —
-    the same rule `lax.top_k` applies, so determinism across partners is
-    unchanged.
+    Implementation note (measured on TPU v5e — the committed artifact is
+    benchmarks/results/merge_bench_TPU_v5_lite.json, `merge` vs
+    `merge_argsort_topk` rows): both stages are multi-operand `lax.sort`
+    calls that carry the payload through the sort instead of `argsort` +
+    `jnp.take` — gathers are the slow path on TPU, and even the final
+    k-selection is faster as a carried sort over the 2k candidates than
+    as `lax.top_k` + two takes at large k. Per round: 1.27 -> 0.18 ms at
+    k=25.6e3 (ResNet-50 rho=0.001), 11.5 -> 1.7 ms at k=2.6e5, 2.7 ->
+    0.37 ms at k=61e3 (VGG-16) — 5-7x at ImageNet-scale N. At CIFAR
+    scale (k<=2.7e3) both formulations sit at 0.12-0.16 ms and the
+    difference is below relevance either way. Stage-2 tie-breaking on
+    equal |value| is stable over the stage-1 canonical (index-sorted)
+    order, i.e. lowest-index-first — the same rule `lax.top_k` applies,
+    so determinism across partners is unchanged.
     """
     cat_idx = jnp.concatenate([idx_a, idx_b])
     cat_val = jnp.concatenate([vals_a, vals_b])

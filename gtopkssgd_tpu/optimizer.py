@@ -192,7 +192,12 @@ def gtopk_sgd(
             acc = compressor.accumulate(flat, state.residual)
             vals, idx, residual = compressor.compress(acc)
             if p == 1:
-                dense = scatter_add_dense(n, idx, vals)
+                # No collective at p=1, so the dense update is exactly
+                # acc - residual' (selected entries keep their acc value,
+                # everything else cancels to 0.0 bit-exactly) — an
+                # elementwise op XLA fuses into the surrounding chain,
+                # instead of materializing a zeros(N) + scatter.
+                dense = acc - residual
             else:
                 result, gidx, needs_repair = sparse_allreduce(
                     mode, vals, idx, k=compressor.k(n), n=n,

@@ -94,6 +94,16 @@ def gtopk_allreduce(
     return vals, idx
 
 
+def _dense_reselect(dense: Array, k: int, n: int) -> Tuple[Array, Array]:
+    """Exact top-k over a densified sparse sum, restoring the sentinel
+    convention (index n, value 0) on empty slots. Shared tail of both
+    allgather-style fallbacks."""
+    gvals, gidx = topk_abs(dense, k)
+    empty = gvals == 0.0
+    gidx = jnp.where(empty, n, gidx).astype(jnp.int32)
+    return gvals, gidx
+
+
 def _allgather_reselect(
     vals: Array,
     idx: Array,
@@ -112,12 +122,7 @@ def _allgather_reselect(
     """
     all_vals = lax.all_gather(vals, axis_name, tiled=True)  # (P*k,)
     all_idx = lax.all_gather(idx, axis_name, tiled=True)
-    dense = scatter_add_dense(n, all_idx, all_vals)
-    gvals, gidx = topk_abs(dense, k)
-    # Preserve the sentinel convention for zero slots.
-    empty = gvals == 0.0
-    gidx = jnp.where(empty, n, gidx).astype(jnp.int32)
-    return gvals, gidx
+    return _dense_reselect(scatter_add_dense(n, all_idx, all_vals), k, n)
 
 
 def ici_dense_psum(x: Array, *, axis_name: str, axis_size: int,
@@ -147,6 +152,10 @@ def ici_dense_psum(x: Array, *, axis_name: str, axis_size: int,
     """
     if ici_size <= 1:
         return x
+    if axis_size % ici_size != 0:
+        raise ValueError(
+            f"axis size {axis_size} not divisible by ici_size={ici_size}"
+        )
     p, s = axis_size, ici_size
 
     def _hypercube(x, width):
@@ -222,11 +231,7 @@ def hier_gtopk_allreduce(
         all_idx = lax.all_gather(idx, axis_name)
         rep_vals = all_vals[::ici_size].reshape(-1)         # [n_slices*k]
         rep_idx = all_idx[::ici_size].reshape(-1)
-        dense = scatter_add_dense(n, rep_idx, rep_vals)
-        gvals, gidx = topk_abs(dense, k)
-        empty = gvals == 0.0
-        gidx = jnp.where(empty, n, gidx).astype(jnp.int32)
-        return gvals, gidx
+        return _dense_reselect(scatter_add_dense(n, rep_idx, rep_vals), k, n)
     rounds = int(math.log2(n_slices))
     for r in range(rounds):
         bit = 1 << r
