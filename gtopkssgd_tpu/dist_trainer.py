@@ -118,26 +118,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # program; ICI inside a slice, DCN across slices — both are just the
         # 'dp' axis to the program (reference: MPI.COMM_WORLD over ethernet).
         jax.distributed.initialize()
-    trainer = Trainer(config_from_args(args))
-    if args.resume:
-        restored = trainer.restore()
-        trainer.logger.info("resume: %s", "restored" if restored else "fresh")
-    if args.profile_dir:
-        # SURVEY.md §5 tracing: the reference only had host timer dicts;
-        # here a real jax.profiler device trace complements them. One step
-        # first so compilation stays out of the trace.
-        trainer.train(1)
-        jax.profiler.start_trace(args.profile_dir)
-        trainer.train(args.profile_steps)
-        jax.profiler.stop_trace()
-        trainer.logger.info("profiler: %d-step trace -> %s",
-                            args.profile_steps, args.profile_dir)
-    if args.num_iters is not None:
-        stats = trainer.train(args.num_iters)
-        stats.update(trainer.test())
-    else:
-        stats = trainer.fit()
-    trainer.logger.info("done: %s", stats)
+    with Trainer(config_from_args(args)) as trainer:
+        if args.resume:
+            restored = trainer.restore()
+            trainer.logger.info("resume: %s",
+                                "restored" if restored else "fresh")
+        if args.profile_dir:
+            # SURVEY.md §5 tracing: the reference only had host timer
+            # dicts; here a real jax.profiler device trace complements
+            # them. One step first so compilation stays out of the trace.
+            trainer.train(1)
+            jax.profiler.start_trace(args.profile_dir)
+            trainer.train(args.profile_steps)
+            jax.profiler.stop_trace()
+            trainer.logger.info("profiler: %d-step trace -> %s",
+                                args.profile_steps, args.profile_dir)
+        if args.num_iters is not None:
+            stats = trainer.train(args.num_iters)
+            stats.update(trainer.test())
+        else:
+            stats = trainer.fit()
+        trainer.logger.info("done: %s", stats)
     return 0
 
 

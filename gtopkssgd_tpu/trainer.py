@@ -48,6 +48,7 @@ from gtopkssgd_tpu.parallel import make_mesh
 from gtopkssgd_tpu.utils import (
     CheckpointManager,
     MetricsLogger,
+    Prefetcher,
     StepTimer,
     get_logger,
 )
@@ -82,6 +83,10 @@ class TrainConfig:
     dtype: str = "float32"         # compute dtype: 'float32' | 'bfloat16'
     eval_batches: Optional[int] = None   # cap eval batches (None = full)
     log_interval: int = 50
+    prefetch: int = 2              # host batches assembled ahead by a
+                                   # background thread (0 = synchronous;
+                                   # reference C8 parity with DataLoader
+                                   # worker overlap)
 
     # --- per-dataset defaults (the reference hardcoded these in DLTrainer) --
     def resolved(self) -> "TrainConfig":
@@ -192,7 +197,36 @@ class Trainer:
                 yield from ds.epoch(e)
                 e += 1
 
-        self._iters = [gen(s, start_epoch) for s in self.train_shards]
+        # Stop the old worker BEFORE the new iterators exist: its produce
+        # closure must never observe them (a batch it pulled from the new
+        # stream would be discarded by close()'s drain — a silent skip).
+        self.close()
+        iters = [gen(s, start_epoch) for s in self.train_shards]
+        self._iters = iters
+        # (Re)start the background prefetcher on the fresh iterators. The
+        # closure binds the local `iters` list, not self._iters, so even a
+        # leaked worker could only ever touch its own generation of
+        # iterators. The worker assembles numpy batches only;
+        # jax.device_put stays on the consumer thread.
+        self._prefetch = (
+            Prefetcher(lambda: self._stack_shard_batches(iters),
+                       depth=self.cfg.prefetch)
+            if self.cfg.prefetch > 0 else None
+        )
+
+    def close(self) -> None:
+        """Release background resources (the prefetch worker). Safe to
+        call repeatedly; training can continue afterwards only via a new
+        `_set_iters` (restore does this) — eval is unaffected."""
+        if getattr(self, "_prefetch", None) is not None:
+            self._prefetch.close()
+            self._prefetch = None
+
+    def __enter__(self) -> "Trainer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # ------------------------------------------------------------------ lr
     def _lr_schedule(self):
@@ -479,7 +513,9 @@ class Trainer:
         step = int(self.state.step)
         for _ in range(num_iters):
             with self.timer("io", sync=False):
-                batch = self._device_batch(self._stack_shard_batches(iters))
+                host = (next(self._prefetch) if self._prefetch is not None
+                        else self._stack_shard_batches(iters))
+                batch = self._device_batch(host)
             self.state, self.carry, loss, aux = self._train_step(
                 self.state, self.carry, batch
             )

@@ -13,6 +13,7 @@ from gtopkssgd_tpu.utils.timers import (
 from gtopkssgd_tpu.utils.metrics import MetricsLogger
 from gtopkssgd_tpu.utils.checkpoint import CheckpointManager
 from gtopkssgd_tpu.utils.settings import get_logger
+from gtopkssgd_tpu.utils.prefetch import Prefetcher
 
 __all__ = [
     "StepTimer",
@@ -23,4 +24,5 @@ __all__ = [
     "MetricsLogger",
     "CheckpointManager",
     "get_logger",
+    "Prefetcher",
 ]

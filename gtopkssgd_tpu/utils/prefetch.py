@@ -1,0 +1,90 @@
+"""Background host-batch prefetcher (reference C8 parity: torch
+DataLoader's worker processes overlapped batch assembly + augmentation
+with GPU compute; here ONE daemon thread overlaps numpy batch assembly —
+including the C++ augment loops, which release the GIL inside
+native.dataprep — with the device step).
+
+Design constraints honored:
+
+  * Determinism: a single worker thread pulls from the underlying
+    iterators strictly in order, so the batch stream is identical to the
+    synchronous path (tested).
+  * JAX single-threaded discipline: the worker touches ONLY numpy/host
+    code; `jax.device_put` stays on the consumer thread.
+  * Failure transparency: an exception in assembly is captured and
+    re-raised at the consumer's next __next__, not swallowed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable
+
+
+class Prefetcher:
+    """Wraps a zero-arg `produce` callable (returns the next host batch)
+    with a bounded background queue of `depth` pre-assembled batches."""
+
+    _STOP = object()
+
+    def __init__(self, produce: Callable[[], object], depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._produce = produce
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._err = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                item = self._produce()
+            except BaseException as e:  # propagate to the consumer
+                self._err = e
+                self._q.put(self._STOP)
+                return
+            # Bounded put that stays responsive to close()
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._err is not None:
+            # Worker already died; fail every subsequent call instead of
+            # blocking forever on a queue that will never be fed again.
+            raise RuntimeError("prefetch worker failed") from self._err
+        item = self._q.get()
+        if item is self._STOP:
+            raise RuntimeError("prefetch worker failed") from self._err
+        return item
+
+    def close(self):
+        """Stop the worker and discard queued batches (used when the
+        underlying iterators are re-created, e.g. on checkpoint restore).
+
+        Raises if the worker cannot be joined: returning with the thread
+        still alive would let a replacement prefetcher race it on the
+        same underlying iterators (generators are not thread-safe).
+        """
+        self._stop.set()
+        # drain so a blocked put wakes up
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=60.0)
+        if self._thread.is_alive():
+            raise RuntimeError(
+                "prefetch worker did not stop within 60 s; "
+                "refusing to hand its iterators to a replacement"
+            )

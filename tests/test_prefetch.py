@@ -1,0 +1,75 @@
+"""Background host-batch prefetcher: ordering, failure, trainer equivalence.
+
+The reference's data pipelines got async batch assembly from torch
+DataLoader worker processes (SURVEY.md C8); here one daemon thread
+overlaps numpy assembly with the device step. The contract that matters:
+the batch stream is EXACTLY the synchronous stream (determinism), and
+worker exceptions surface at the consumer.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from gtopkssgd_tpu.utils import Prefetcher
+
+
+def test_order_preserved():
+    src = iter(range(100))
+    pf = Prefetcher(lambda: next(src), depth=3)
+    got = [next(pf) for _ in range(50)]
+    pf.close()
+    assert got == list(range(50))
+
+
+def test_worker_exception_propagates():
+    def produce():
+        raise ValueError("boom")
+
+    pf = Prefetcher(produce, depth=2)
+    with pytest.raises(RuntimeError, match="prefetch worker failed"):
+        next(pf)
+    pf.close()
+
+
+def test_next_after_failure_keeps_raising():
+    def produce():
+        raise ValueError("boom")
+
+    pf = Prefetcher(produce, depth=2)
+    for _ in range(3):  # every call fails; none may block
+        with pytest.raises(RuntimeError, match="prefetch worker failed"):
+            next(pf)
+    pf.close()
+
+
+def test_close_unblocks_full_queue():
+    pf = Prefetcher(lambda: 1, depth=1)
+    time.sleep(0.2)  # let the worker fill the queue and block on put
+    pf.close()       # must not hang
+    assert not pf._thread.is_alive()
+
+
+def test_bad_depth():
+    with pytest.raises(ValueError):
+        Prefetcher(lambda: 1, depth=0)
+
+
+def test_trainer_stream_identical_with_and_without_prefetch():
+    """Two trainers, same seed, prefetch on vs off: identical loss
+    trajectory — the prefetcher must not reorder, drop, or duplicate
+    batches."""
+    from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+    def losses(prefetch):
+        with Trainer(TrainConfig(
+            dnn="resnet20", batch_size=2, nworkers=8, compression="gtopk",
+            density=0.01, max_epochs=1, log_interval=1, eval_batches=1,
+            prefetch=prefetch,
+        )) as t:
+            return [float(t.train(1)["loss"]) for _ in range(3)]
+
+    a = losses(0)
+    b = losses(2)
+    np.testing.assert_array_equal(a, b)
