@@ -63,16 +63,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from gtopkssgd_tpu.trainer import TrainConfig, Trainer
 
-    trainer = Trainer(TrainConfig(**spec))
-    if args.resume:
-        restored = trainer.restore()
-        trainer.logger.info("resume: %s", "restored" if restored else "fresh")
-    if args.num_iters is not None:
-        stats = trainer.train(args.num_iters)
-        stats.update(trainer.test())
-    else:
-        stats = trainer.fit()
-    trainer.logger.info("done: %s", stats)
+    with Trainer(TrainConfig(**spec)) as trainer:
+        if args.resume:
+            restored = trainer.restore()
+            trainer.logger.info("resume: %s",
+                                "restored" if restored else "fresh")
+        if args.num_iters is not None:
+            stats = trainer.train(args.num_iters)
+            stats.update(trainer.test())
+        else:
+            stats = trainer.fit()
+        trainer.logger.info("done: %s", stats)
     return 0
 
 

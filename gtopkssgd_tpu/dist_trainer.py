@@ -73,6 +73,9 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="train a fixed number of steps instead of epochs")
     p.add_argument("--eval-batches", type=int, default=None)
     p.add_argument("--log-interval", type=int, default=50)
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="host batches assembled ahead by a background "
+                        "thread (0 = synchronous assembly)")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint from out-dir")
     p.add_argument("--multihost", action="store_true",
@@ -108,6 +111,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         dtype=args.dtype,
         eval_batches=args.eval_batches,
         log_interval=args.log_interval,
+        prefetch=args.prefetch,
     )
 
 

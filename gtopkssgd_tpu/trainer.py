@@ -511,6 +511,14 @@ class Trainer:
         # block on the device every iteration and kill async IO/compute
         # overlap; the mirror is exact (the step increments by 1 per call).
         step = int(self.state.step)
+        if self.cfg.prefetch > 0 and self._prefetch is None:
+            # close() drained batches the worker had already pulled from
+            # self._iters; silently falling back to the sync path would
+            # skip them. Training may only resume through _set_iters
+            # (restore() does this) or a fresh Trainer.
+            raise RuntimeError(
+                "Trainer is closed; restore() or build a new Trainer"
+            )
         for _ in range(num_iters):
             with self.timer("io", sync=False):
                 host = (next(self._prefetch) if self._prefetch is not None

@@ -35,6 +35,7 @@ class Prefetcher:
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._err = None
+        self._closed = False
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -58,6 +59,10 @@ class Prefetcher:
         return self
 
     def __next__(self):
+        if self._closed:
+            # The worker is gone and the queue drained; blocking on get()
+            # would hang forever.
+            raise RuntimeError("prefetcher is closed")
         if self._err is not None:
             # Worker already died; fail every subsequent call instead of
             # blocking forever on a queue that will never be fed again.
@@ -75,6 +80,7 @@ class Prefetcher:
         still alive would let a replacement prefetcher race it on the
         same underlying iterators (generators are not thread-safe).
         """
+        self._closed = True
         self._stop.set()
         # drain so a blocked put wakes up
         try:

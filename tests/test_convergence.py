@@ -20,13 +20,15 @@ from gtopkssgd_tpu.parallel import make_mesh
 PDEV, BATCH, STEPS = 4, 8, 40
 
 
-def run_mode(mode, density, seed=0, steps=STEPS):
+def run_mode(mode, density, seed=0, steps=STEPS, topk_method="auto",
+             hier_ici=1):
     model, spec = get_model("resnet20")
     rng = jax.random.PRNGKey(seed)
     variables = model.init({"params": rng}, jnp.zeros((1, 32, 32, 3)))
     params, bstats = variables["params"], variables["batch_stats"]
     tx = gtopk_sgd(0.05, momentum=0.9, compression=mode, density=density,
-                   axis_name="dp")
+                   axis_name="dp", topk_method=topk_method,
+                   hier_ici_size=hier_ici)
     mesh = make_mesh(PDEV)
 
     npr = np.random.default_rng(1)
@@ -81,6 +83,29 @@ def test_gtopk_tracks_dense(dense_losses):
 def test_allgather_tracks_dense(dense_losses):
     dgc = run_mode("allgather", 0.01)
     assert dgc[-1] < 0.5 * dgc[0], dgc[::10]
+
+
+def test_hier_tracks_dense(dense_losses):
+    """The hierarchical two-level mode (dense within 2-device slices,
+    gtopk across) must converge like plain gtopk — its global set is the
+    gTop-k of slice sums, an intermediate point between local and exact
+    top-k selection."""
+    hier = run_mode("gtopk_hier", 0.01, hier_ici=2)
+    assert hier[-1] < 0.5 * hier[0], hier[::10]
+    assert hier[-1] < dense_losses[0]
+
+
+def test_gtopk_converges_under_approx_selection(dense_losses):
+    """Production 'auto' selects lax.approx_max_k (recall 0.95) above 2^20
+    params; ResNet-20 sits below that threshold, so the other convergence
+    arms all exercise EXACT selection. This arm forces the approx kernel
+    at CIFAR scale to pin down the claim that recall<1 local selection is
+    absorbed by error feedback (missed elements stay in the residual and
+    win a later round) — the justification in ops/topk.py for making
+    approx the production path at ImageNet scale."""
+    approx = run_mode("gtopk", 0.01, topk_method="approx")
+    assert approx[-1] < 0.5 * approx[0], approx[::10]
+    assert approx[-1] < dense_losses[0]
 
 
 def test_gtopk_rho001_long_horizon():

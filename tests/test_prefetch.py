@@ -56,6 +56,25 @@ def test_bad_depth():
         Prefetcher(lambda: 1, depth=0)
 
 
+def test_next_after_close_raises():
+    pf = Prefetcher(lambda: 1, depth=1)
+    pf.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        next(pf)
+
+
+def test_trainer_train_after_close_raises():
+    from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+    t = Trainer(TrainConfig(
+        dnn="resnet20", batch_size=2, nworkers=1, compression=None,
+        max_epochs=1, eval_batches=1,
+    ))
+    t.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        t.train(1)
+
+
 def test_trainer_stream_identical_with_and_without_prefetch():
     """Two trainers, same seed, prefetch on vs off: identical loss
     trajectory — the prefetcher must not reorder, drop, or duplicate
