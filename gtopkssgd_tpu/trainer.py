@@ -517,7 +517,8 @@ class Trainer:
             # skip them. Training may only resume through _set_iters
             # (restore() does this) or a fresh Trainer.
             raise RuntimeError(
-                "Trainer is closed; restore() or build a new Trainer"
+                "Trainer is closed; build a new Trainer (restore() "
+                "re-opens it only when a saved checkpoint exists)"
             )
         for _ in range(num_iters):
             with self.timer("io", sync=False):

@@ -35,7 +35,6 @@ class Prefetcher:
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._err = None
-        self._closed = False
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -59,9 +58,9 @@ class Prefetcher:
         return self
 
     def __next__(self):
-        if self._closed:
-            # The worker is gone and the queue drained; blocking on get()
-            # would hang forever.
+        if self._stop.is_set():
+            # close() ran (nothing else sets _stop): the worker is gone
+            # and the queue drained; blocking on get() would hang forever.
             raise RuntimeError("prefetcher is closed")
         if self._err is not None:
             # Worker already died; fail every subsequent call instead of
@@ -80,7 +79,6 @@ class Prefetcher:
         still alive would let a replacement prefetcher race it on the
         same underlying iterators (generators are not thread-safe).
         """
-        self._closed = True
         self._stop.set()
         # drain so a blocked put wakes up
         try:

@@ -98,11 +98,17 @@ def test_hier_tracks_dense(dense_losses):
 def test_gtopk_converges_under_approx_selection(dense_losses):
     """Production 'auto' selects lax.approx_max_k (recall 0.95) above 2^20
     params; ResNet-20 sits below that threshold, so the other convergence
-    arms all exercise EXACT selection. This arm forces the approx kernel
-    at CIFAR scale to pin down the claim that recall<1 local selection is
-    absorbed by error feedback (missed elements stay in the residual and
-    win a later round) — the justification in ops/topk.py for making
-    approx the production path at ImageNet scale."""
+    arms all exercise EXACT selection. This arm forces the approx code
+    path end-to-end through the optimizer.
+
+    Honest scope note: on the CPU CI mesh XLA lowers ApproxTopK to an
+    exact fallback, so recall here is 1 and this test pins the CALL PATH,
+    not the recall<1 convergence claim itself. The recall<1 argument
+    (missed elements stay in the residual and win a later round — the
+    same error-feedback argument that justifies top-k sparsification,
+    arXiv:1911.08772) is backed on real hardware by the selection-quality
+    numbers in benchmarks/results/topk_bench_TPU_v5_lite.json; a TPU-run
+    convergence arm would be the full pin."""
     approx = run_mode("gtopk", 0.01, topk_method="approx")
     assert approx[-1] < 0.5 * approx[0], approx[::10]
     assert approx[-1] < dense_losses[0]

@@ -58,7 +58,21 @@ res_after = np.asarray(
 np.testing.assert_array_equal(res_before, res_after)
 t2.train(1)
 assert int(t2.state.step) == 3
-print(f"MULTIHOST-OK pid={pid} loss={stats['loss']:.4f}")
+t.close(); t2.close()
+
+# Hierarchical mode across the PROCESS boundary: with 2 processes x 1
+# device and hier_ici=2 there is ONE slice spanning both processes, so the
+# intra-slice dense psum itself crosses DCN-analogue transport — the
+# degenerate-but-real case (cross-slice tree empty, level-1 psum does all
+# the reducing) that no single-process test can exercise.
+hcfg = TrainConfig(dnn="resnet20", batch_size=4, nworkers=2,
+                   compression="gtopk_hier", hier_ici=2, density=0.01,
+                   max_epochs=1, log_interval=1, eval_batches=1)
+with Trainer(hcfg) as th:
+    hstats = th.train(1)
+    assert np.isfinite(hstats["loss"]), hstats
+print(f"MULTIHOST-OK pid={pid} loss={stats['loss']:.4f} "
+      f"hier_loss={hstats['loss']:.4f}")
 """
 
 
