@@ -41,8 +41,11 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import sys
 
-from gtopkssgd_tpu.parallel.collectives import _is_pow2
+
+def _is_pow2(p: int) -> bool:
+    return p > 0 and (p & (p - 1)) == 0
 
 
 def _ring_allreduce_bytes(n_bytes: int, p: int) -> float:
@@ -129,15 +132,13 @@ def main():
               dcn_gbps=args.dcn_gbps, ici_size=args.ici_size,
               batch=args.batch)
     print(json.dumps({"model": "bandwidth-only projection (see docstring)",
-                      "k": k, **{a: getattr(args, a.replace('-', '_'))
+                      "k": k, **{a: getattr(args, a)
                                  for a in ("compute_ms", "overhead_ms",
                                            "n", "density", "batch",
                                            "ici_gbps", "dcn_gbps",
                                            "ici_size")}}))
     for p in args.ps:
         if not _is_pow2(p):
-            import sys
-
             print(f"# skipping P={p}: projection models the pow2 "
                   f"hypercube; ragged P falls back to the allgather "
                   f"class (see parallel.collectives)", file=sys.stderr)

@@ -7,8 +7,14 @@ stand-in with identical shapes/dtypes and a learnable class signal (class
 mean offsets), so smoke training shows a falling loss without any download.
 
 Augmentation matches the reference recipe: 4-pixel reflection pad + random
-32x32 crop + horizontal flip, then per-channel mean/std normalization. All
-host-side numpy; batches are NHWC float32.
+32x32 crop + horizontal flip, host-side (C++ when built, numpy fallback).
+
+Wire format is **uint8**: batches cross host->device as raw NHWC pixels (a
+quarter of the float32 bytes — minimize H2D, the TPU-first rule) and the
+per-channel mean/std normalization runs ON DEVICE inside the jitted step
+(trainer._loss_fn), where XLA fuses it into the first conv. The reference
+normalized on the host (torchvision ToTensor+Normalize) — same math,
+different placement.
 """
 
 from __future__ import annotations
@@ -45,7 +51,7 @@ def _load_real(data_dir: str, split: str):
         )
         labels.append(np.asarray(d[b"labels"], np.int32))
     return (
-        np.concatenate(images).astype(np.float32) / 255.0,
+        np.ascontiguousarray(np.concatenate(images)),  # u8 raw pixels
         np.concatenate(labels),
     )
 
@@ -61,7 +67,9 @@ def _synthetic(split: str, seed: int):
     offsets = rng.standard_normal((10, 3)).astype(np.float32) * 0.25
     images = 0.5 + 0.15 * rng.standard_normal((n, 32, 32, 3)).astype(np.float32)
     images += offsets[labels][:, None, None, :]
-    return np.clip(images, 0.0, 1.0), labels
+    images = np.clip(images, 0.0, 1.0)
+    # quantize once to the uint8 wire format (what real pickles hold)
+    return (images * 255.0).round().astype(np.uint8), labels
 
 
 class CIFAR10Dataset:
@@ -95,7 +103,7 @@ class CIFAR10Dataset:
         return len(self.partitioner) // self.batch_size
 
     def _augment(self, x: np.ndarray) -> np.ndarray:
-        """Fused pad+crop+flip+normalize. RNG draws happen here (numpy side)
+        """Fused pad+crop+flip on uint8. RNG draws happen here (numpy side)
         so the C++ and fallback paths are bit-identical; the pixel work runs
         in the native library when built (gtopkssgd_tpu.native)."""
         from gtopkssgd_tpu import native
@@ -104,20 +112,17 @@ class CIFAR10Dataset:
         ys = self._rng.integers(0, 9, b).astype(np.int32)
         xs = self._rng.integers(0, 9, b).astype(np.int32)
         flips = self._rng.random(b) < 0.5
-        return native.cifar_augment_batch(
-            x, ys, xs, flips, CIFAR_MEAN, CIFAR_STD
-        )
+        return native.cifar_augment_batch(x, ys, xs, flips)
 
     def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
-        """One pass over this rank's shard, in the shared per-epoch order."""
+        """One pass over this rank's shard, in the shared per-epoch order.
+        Batches are raw uint8 either way; normalization is on-device."""
         idx = self.partitioner.indices(epoch)
         for lo in range(0, len(idx) - self.batch_size + 1, self.batch_size):
             sel = idx[lo:lo + self.batch_size]
             x = self.images[sel]
             if self.augment:
-                x = self._augment(x)  # normalization fused in
-            else:
-                x = ((x - CIFAR_MEAN) / CIFAR_STD).astype(np.float32)
+                x = self._augment(x)
             yield {"image": x, "label": self.labels[sel]}
 
     def __iter__(self):

@@ -3,12 +3,20 @@ train/val directory layout inside dl_trainer.py).
 
 Real path: ``data_dir/{train,val}/<wnid>/*.JPEG`` decoded with PIL,
 random-resized-crop(224) + flip for train, resize(256)+center-crop(224) for
-eval, ImageNet mean/std normalization — the reference's torchvision recipe
-re-implemented host-side in numpy/PIL.
+eval — the reference's torchvision recipe re-implemented host-side in
+numpy/PIL.
 
-Synthetic fallback generates class-conditional noise at full 224x224 so the
-ResNet-50/AlexNet benchmark path runs with the true compute shape in a
-zero-egress environment.
+Wire format is **uint8**: batches cross host->device as raw pixels (a
+quarter of the float32 bytes — the TPU-first rule of minimizing H2D
+transfer; on this environment's tunneled chip, measured ~45 MB/s, the f32
+format alone cost ~800 ms per 64-image batch) and the ImageNet mean/std
+normalization runs ON DEVICE inside the jitted step (trainer._loss_fn),
+fused by XLA into the first conv. The reference normalized on the host
+(torchvision ToTensor+Normalize) — same math, different placement.
+
+Synthetic fallback generates class-conditional uint8 noise at full 224x224
+so the ResNet-50/AlexNet benchmark path runs with the true compute shape
+in a zero-egress environment.
 """
 
 from __future__ import annotations
@@ -84,6 +92,7 @@ class ImageNetDataset:
 
     # --- real-image decode path -------------------------------------------
     def _decode(self, path: str) -> np.ndarray:
+        """Decode + crop/flip, staying in uint8 end to end."""
         from PIL import Image
 
         s = self.image_size
@@ -105,7 +114,7 @@ class ImageNetDataset:
                         break
                 else:
                     im = im.resize((s, s))
-                arr = np.asarray(im, np.float32) / 255.0
+                arr = np.asarray(im, np.uint8)
                 if self._rng.random() < 0.5:
                     arr = arr[:, ::-1]
             else:
@@ -114,24 +123,26 @@ class ImageNetDataset:
                 im = im.resize((int(w * scale), int(h * scale)))
                 w, h = im.size
                 x0, y0 = (w - s) // 2, (h - s) // 2
-                arr = (
-                    np.asarray(im, np.float32)[y0:y0 + s, x0:x0 + s] / 255.0
-                )
+                arr = np.asarray(im, np.uint8)[y0:y0 + s, x0:x0 + s]
         return arr
 
     def _synth_batch(self, sel: np.ndarray) -> np.ndarray:
         """Deterministic per-index generation: sample i is the same array on
         every pass and in every process, so eval metrics are comparable
-        across epochs/runs without holding n*224*224*3 floats resident."""
+        across epochs/runs without holding the whole set resident. uint8
+        noise via integers() — an order of magnitude cheaper per sample
+        than box-muller normals, which dominated host batch time."""
         s = self.image_size
-        out = np.empty((len(sel), s, s, 3), np.float32)
+        out = np.empty((len(sel), s, s, 3), np.int16)
         for j, i in enumerate(sel):
             rng = np.random.default_rng(
                 np.random.SeedSequence([self._seed, _split_id(self.split), int(i)])
             )
-            out[j] = 0.5 + 0.15 * rng.standard_normal((s, s, 3))
-        out += self._offsets[self._labels[sel]][:, None, None, :]
-        return np.clip(out, 0.0, 1.0)
+            out[j] = rng.integers(64, 192, (s, s, 3), dtype=np.int16)
+        # class-conditional channel shift so labels are learnable
+        shift = (self._offsets[self._labels[sel]] * 255).astype(np.int16)
+        out += shift[:, None, None, :]
+        return np.clip(out, 0, 255).astype(np.uint8)
 
     def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         idx = self.partitioner.indices(epoch)
@@ -141,8 +152,7 @@ class ImageNetDataset:
                 x = self._synth_batch(sel)
             else:
                 x = np.stack([self._decode(self._paths[i]) for i in sel])
-            x = (x - IMAGENET_MEAN) / IMAGENET_STD
-            yield {"image": x.astype(np.float32), "label": self._labels[sel]}
+            yield {"image": x, "label": self._labels[sel]}
 
     def __iter__(self):
         e = 0

@@ -24,8 +24,8 @@ constexpr int kPH = kH + 2 * kPad, kPW = kW + 2 * kPad;
 
 // Reflect-pad one HWC image into a padded buffer (mode='reflect', matching
 // numpy: index mirrors without repeating the edge pixel).
-void reflect_pad(const float* in, float* out) {
-  auto src = [&](int y, int x, int c) -> float {
+void reflect_pad(const uint8_t* in, uint8_t* out) {
+  auto src = [&](int y, int x, int c) -> uint8_t {
     return in[(y * kW + x) * kC + c];
   };
   for (int y = 0; y < kPH; ++y) {
@@ -42,16 +42,19 @@ void reflect_pad(const float* in, float* out) {
   }
 }
 
-void augment_one(const float* in, float* out, int y0, int x0, bool flip,
-                 const float* mean, const float* inv_std) {
-  float padded[kPH * kPW * kC];
+// uint8 end to end: images stay raw pixels through augmentation (the wire
+// format is uint8 — 4x fewer H2D bytes — and mean/std normalization runs
+// on device inside the jitted step, not here).
+void augment_one(const uint8_t* in, uint8_t* out, int y0, int x0,
+                 bool flip) {
+  uint8_t padded[kPH * kPW * kC];
   reflect_pad(in, padded);
   for (int y = 0; y < kH; ++y) {
     for (int x = 0; x < kW; ++x) {
       int sx = flip ? (x0 + kW - 1 - x) : (x0 + x);
-      const float* p = &padded[((y0 + y) * kPW + sx) * kC];
-      float* q = &out[(y * kW + x) * kC];
-      for (int c = 0; c < kC; ++c) q[c] = (p[c] - mean[c]) * inv_std[c];
+      const uint8_t* p = &padded[((y0 + y) * kPW + sx) * kC];
+      uint8_t* q = &out[(y * kW + x) * kC];
+      for (int c = 0; c < kC; ++c) q[c] = p[c];
     }
   }
 }
@@ -74,31 +77,16 @@ void parallel_for(int n, const std::function<void(int, int)>& fn) {
 
 extern "C" {
 
-// in/out: f32[B,32,32,3]; ys/xs: i32[B] crop offsets in [0,8]; flips:
-// u8[B]; mean/std: f32[3]. Fused reflect-pad(4) + crop + hflip + normalize.
-void cifar_augment_batch(const float* in, float* out, int b, const int* ys,
-                         const int* xs, const uint8_t* flips,
-                         const float* mean, const float* stddev) {
-  float inv_std[kC];
-  for (int c = 0; c < kC; ++c) inv_std[c] = 1.0f / stddev[c];
+// in/out: u8[B,32,32,3]; ys/xs: i32[B] crop offsets in [0,8]; flips:
+// u8[B]. Fused reflect-pad(4) + crop + hflip, raw pixels in and out.
+void cifar_augment_batch(const uint8_t* in, uint8_t* out, int b,
+                         const int* ys, const int* xs,
+                         const uint8_t* flips) {
   parallel_for(b, [&](int lo, int hi) {
     for (int i = lo; i < hi; ++i)
       augment_one(in + (size_t)i * kH * kW * kC,
                   out + (size_t)i * kH * kW * kC, ys[i], xs[i],
-                  flips[i] != 0, mean, inv_std);
-  });
-}
-
-// Normalize only (eval path): out = (in - mean) / std over f32[B,H,W,3].
-void normalize_batch(const float* in, float* out, int64_t n_pixels,
-                     const float* mean, const float* stddev) {
-  float inv_std[kC];
-  for (int c = 0; c < kC; ++c) inv_std[c] = 1.0f / stddev[c];
-  parallel_for((int)std::min<int64_t>(n_pixels, 1 << 30),
-               [&](int lo, int hi) {
-    for (int64_t p = lo; p < hi; ++p)
-      for (int c = 0; c < kC; ++c)
-        out[p * kC + c] = (in[p * kC + c] - mean[c]) * inv_std[c];
+                  flips[i] != 0);
   });
 }
 

@@ -38,6 +38,8 @@ from jax.sharding import PartitionSpec as P
 
 from gtopkssgd_tpu import native
 from gtopkssgd_tpu.data import get_dataset
+from gtopkssgd_tpu.data.cifar import CIFAR_MEAN, CIFAR_STD
+from gtopkssgd_tpu.data.imagenet import IMAGENET_MEAN, IMAGENET_STD
 from gtopkssgd_tpu.models import get_model
 from gtopkssgd_tpu.optimizer import (
     GTopKSGDState,
@@ -109,6 +111,14 @@ class TrainConfig:
         if cfg.clip_grad_norm is None:
             cfg.clip_grad_norm = clip
         return cfg
+
+
+# Per-dataset normalization constants for the uint8 wire format: pipelines
+# ship raw pixels, the jitted step normalizes on device.
+_WIRE_STATS = {
+    "cifar10": (CIFAR_MEAN, CIFAR_STD),
+    "imagenet": (IMAGENET_MEAN, IMAGENET_STD),
+}
 
 
 class TrainState(NamedTuple):
@@ -356,7 +366,16 @@ class Trainer:
             aux = {} if train else {"logits": logits}
             return loss, (new_bs, carry, aux)
         # vision
-        logits, new_bs = run(batch["image"])
+        x = batch["image"]
+        if x.dtype == jnp.uint8:
+            # Vision pipelines ship raw uint8 pixels across H2D (4x fewer
+            # bytes than f32) and normalize HERE, on device, where XLA
+            # fuses it into the first conv (wire-format notes in
+            # data/cifar.py and data/imagenet.py).
+            mean, std = _WIRE_STATS[self.cfg.dataset]
+            x = (x.astype(jnp.float32) / 255.0 - jnp.asarray(mean)
+                 ) / jnp.asarray(std)
+        logits, new_bs = run(x)
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, batch["label"]
         ).mean()

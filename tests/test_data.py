@@ -39,11 +39,9 @@ def test_cifar_synthetic_batches():
     assert ds.synthetic
     batch = next(iter(ds))
     assert batch["image"].shape == (16, 32, 32, 3)
-    assert batch["image"].dtype == np.float32
+    assert batch["image"].dtype == np.uint8  # wire format: raw pixels,
     assert batch["label"].shape == (16,) and batch["label"].dtype == np.int32
-    assert ds.steps_per_epoch() > 0
-    # normalized: roughly zero-mean
-    assert abs(batch["image"].mean()) < 1.0
+    assert ds.steps_per_epoch() > 0         # normalization is on-device
 
 
 def test_cifar_rank_shards_disjoint_same_epoch():
@@ -65,7 +63,8 @@ def test_imagenet_synthetic():
     ds = get_dataset("imagenet", batch_size=4, num_classes=50)
     batch = next(iter(ds))
     assert batch["image"].shape == (4, 224, 224, 3)
-    assert batch["label"].max() < 50
+    assert batch["image"].dtype == np.uint8  # wire format: raw pixels,
+    assert batch["label"].max() < 50         # normalization is on-device
 
 
 def test_ptb_bptt_windows_and_carry_layout():

@@ -148,3 +148,20 @@ def test_per_dataset_defaults_resolve():
     assert cfg.dataset == "ptb" and cfg.clip_grad_norm == 0.25
     cfg = TrainConfig(dnn="resnet50").resolved()
     assert cfg.dataset == "imagenet" and cfg.lr == 0.1
+
+
+def test_imagenet_uint8_wire_trains_one_step():
+    """End-to-end through the uint8 wire format: the ImageNet pipeline
+    ships raw pixels, the jitted step normalizes on device — one real
+    ResNet-50 step + eval must produce finite losses. (The pipelines'
+    dtype is pinned in tests/test_data.py; this pins the consumer.)"""
+    import numpy as np
+
+    with Trainer(TrainConfig(
+        dnn="resnet50", batch_size=2, nworkers=1, compression="gtopk",
+        density=0.01, max_epochs=1, log_interval=1, eval_batches=1,
+    )) as t:
+        stats = t.train(1)
+        assert np.isfinite(stats["loss"]), stats
+        ev = t.test()
+        assert np.isfinite(ev["val_loss"]) and "val_top5" in ev
