@@ -34,6 +34,9 @@ import jax
 
 
 def main():
+    from gtopkssgd_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--dnn", default="resnet50")
     ap.add_argument("--batch-size", type=int, default=128)

@@ -142,6 +142,9 @@ VARIANTS = {
 
 
 def main():
+    from gtopkssgd_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
     ap.add_argument("--quick", action="store_true")

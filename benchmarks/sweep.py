@@ -25,6 +25,9 @@ from gtopkssgd_tpu.benchmark import (
 
 
 def main():
+    from gtopkssgd_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--dnn", default="resnet20")
     ap.add_argument("--batch-size", type=int, default=256)

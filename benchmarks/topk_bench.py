@@ -68,6 +68,9 @@ def time_method(method: str, n: int, k: int, min_seconds: float = 1.0):
 
 
 def main():
+    from gtopkssgd_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
     ap.add_argument("--quick", action="store_true",

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import time
 
@@ -52,7 +53,7 @@ def bench_workload(dnn: str, batch: int, extra: dict, steps: int):
         "steps": steps,
         "samples_per_sec": round(run["throughput"], 2),
         "step_ms": round(run["wall"] / steps * 1e3, 2),
-        "loss_finite": bool(run["loss"] == run["loss"]),
+        "loss_finite": math.isfinite(run["loss"]),
         "eval_keys": sorted(ev.keys()),
         "build_seconds": round(build_s, 1),
         "compile_seconds": round(warm["wall"], 1),
@@ -77,6 +78,9 @@ def measure_h2d_mbps() -> float:
 
 
 def main():
+    from gtopkssgd_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--quick", action="store_true")

@@ -116,6 +116,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    from gtopkssgd_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     args = build_argparser().parse_args(argv)
     if args.multihost:
         # Multi-host pod slice / multislice: one process per host, same SPMD

@@ -224,20 +224,30 @@ def expand_residual_per_device(opt_state: GTopKSGDState, p: int, mesh):
     """Lift the freshly-initialized [N] residual to the per-device [P, N]
     convention used under shard_map (leading dim = 'dp'; strip with
     residual[0] inside the block, restore with residual[None] on the way
-    out). The residual at init is zeros by construction, so the expansion
-    is built DIRECTLY into its P('dp') sharding — a host-side broadcast
-    would materialize the dense [P, N] array on one device first (1.6 GB
-    for ResNet-50 x 16 workers). Shared by the trainer and the benchmark
-    so their measured paths cannot drift.
+    out). The residual at init is zeros by construction, so each device's
+    shard is created DIRECTLY in its P('dp') placement
+    (make_array_from_callback) — a host-side broadcast would materialize
+    the dense [P, N] array on one device first (1.6 GB for ResNet-50 x 16
+    workers), and a jitted zeros-with-out_shardings hits a jax sharding-
+    override assertion when the persistent compilation cache is enabled.
+    Shared by the trainer and the benchmark so their measured paths
+    cannot drift.
     """
+    import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec
 
     res_shape = (p,) + opt_state.residual.shape
     res_dtype = opt_state.residual.dtype
-    return opt_state._replace(residual=jax.jit(
-        lambda: jnp.zeros(res_shape, res_dtype),
-        out_shardings=NamedSharding(mesh, PartitionSpec("dp")),
-    )())
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+
+    def shard_zeros(index):
+        shape = tuple(len(range(*s.indices(dim)))
+                      for s, dim in zip(index, res_shape))
+        return np.zeros(shape, res_dtype)
+
+    return opt_state._replace(residual=jax.make_array_from_callback(
+        res_shape, sharding, shard_zeros,
+    ))
 
 
 def effective_density(compression: Optional[str], density: float) -> float:

@@ -12,7 +12,7 @@ from gtopkssgd_tpu.utils.timers import (
 )
 from gtopkssgd_tpu.utils.metrics import MetricsLogger
 from gtopkssgd_tpu.utils.checkpoint import CheckpointManager
-from gtopkssgd_tpu.utils.settings import get_logger
+from gtopkssgd_tpu.utils.settings import enable_compilation_cache, get_logger
 from gtopkssgd_tpu.utils.prefetch import Prefetcher
 
 __all__ = [
@@ -24,5 +24,6 @@ __all__ = [
     "MetricsLogger",
     "CheckpointManager",
     "get_logger",
+    "enable_compilation_cache",
     "Prefetcher",
 ]

@@ -17,6 +17,24 @@ DEBUG = bool(int(os.environ.get("GTOPK_DEBUG", "0")))
 # Flag-guarded per-step timing decomposition (reference profiling switch).
 PROFILING = bool(int(os.environ.get("GTOPK_PROFILING", "1")))
 
+
+def enable_compilation_cache(
+    path: str | None = None,
+) -> None:
+    """Point jax at a persistent on-disk compilation cache so repeated
+    CLI/benchmark invocations skip the 20-60 s XLA compiles (the driver
+    runs bench.py cold every round). Override dir with GTOPK_JIT_CACHE;
+    no-op if jax already has a cache configured."""
+    import jax
+
+    if jax.config.jax_compilation_cache_dir:
+        return
+    path = path or os.environ.get("GTOPK_JIT_CACHE",
+                                  "/tmp/jax_cache_gtopkssgd")
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 _FMT = "%(asctime)s [%(name)s:r{rank}] %(levelname)s %(message)s"
 
 
