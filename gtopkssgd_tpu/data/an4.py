@@ -27,6 +27,7 @@ from typing import Dict, Iterator, List
 import numpy as np
 
 from gtopkssgd_tpu.data.partition import DataPartitioner
+from gtopkssgd_tpu.data.partition import signal_rng as _signal_rng
 from gtopkssgd_tpu.data.partition import split_id as _split_id
 
 # Blank at 0, then apostrophe, A-Z, space — the deepspeech English labels.
@@ -62,7 +63,10 @@ def _synth_utterances(split: str, seed: int, num_chars: int) -> List[Dict]:
     and seeded stably across processes (crc32, not hash())."""
     rng = np.random.default_rng(np.random.SeedSequence([seed, _split_id(split)]))
     n = SYNTH_TRAIN if split == "train" else SYNTH_TEST
-    signatures = rng.standard_normal((num_chars, N_BINS)).astype(np.float32)
+    # Split-INDEPENDENT per-char signatures: train and test must share the
+    # char->spectrum mapping or held-out CER/WER on synthetic data is noise.
+    signatures = _signal_rng(seed).standard_normal(
+        (num_chars, N_BINS)).astype(np.float32)
     utts: List[Dict] = []
     for _ in range(n):
         L = int(rng.integers(4, 12))

@@ -27,6 +27,7 @@ from typing import Dict, Iterator
 import numpy as np
 
 from gtopkssgd_tpu.data.partition import DataPartitioner
+from gtopkssgd_tpu.data.partition import signal_rng as _signal_rng
 from gtopkssgd_tpu.data.partition import split_id as _split_id
 
 CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
@@ -64,7 +65,11 @@ def _synthetic(split: str, seed: int):
     n = SYNTH_TRAIN if split == "train" else SYNTH_TEST
     rng = np.random.default_rng(np.random.SeedSequence([seed, _split_id(split)]))
     labels = rng.integers(0, 10, n).astype(np.int32)
-    offsets = rng.standard_normal((10, 3)).astype(np.float32) * 0.25
+    # Class offsets come from the SPLIT-INDEPENDENT signal stream: train and
+    # test must share the class signal or held-out eval on synthetic data is
+    # structurally chance-level (the bug that made every synthetic val_top1
+    # read ~0.1 before this).
+    offsets = _signal_rng(seed).standard_normal((10, 3)).astype(np.float32) * 0.25
     images = 0.5 + 0.15 * rng.standard_normal((n, 32, 32, 3)).astype(np.float32)
     images += offsets[labels][:, None, None, :]
     images = np.clip(images, 0.0, 1.0)

@@ -28,6 +28,7 @@ from typing import Dict, Iterator, List, Tuple
 import numpy as np
 
 from gtopkssgd_tpu.data.partition import DataPartitioner
+from gtopkssgd_tpu.data.partition import signal_rng as _signal_rng
 from gtopkssgd_tpu.data.partition import split_id as _split_id
 
 IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
@@ -70,8 +71,11 @@ class ImageNetDataset:
                 np.random.SeedSequence([seed, _split_id(split)])
             )
             self._labels = rng.integers(0, num_classes, n).astype(np.int32)
+            # Split-INDEPENDENT class offsets: train and val must share the
+            # class signal or held-out eval on synthetic data is chance.
             self._offsets = (
-                rng.standard_normal((num_classes, 3)).astype(np.float32) * 0.25
+                _signal_rng(seed)
+                .standard_normal((num_classes, 3)).astype(np.float32) * 0.25
             )
             self._paths = None
             count = n

@@ -23,6 +23,20 @@ def split_id(split: str) -> int:
     return zlib.crc32(split.encode())
 
 
+#: Stream key for the split-INDEPENDENT part of a synthetic dataset — the
+#: class signal (CIFAR/ImageNet class-mean offsets, AN4 per-char spectral
+#: signatures). Train and held-out splits must draw the signal from the
+#: same stream or eval on synthetic data is structurally chance-level;
+#: every generator goes through signal_rng() so none can drift back to a
+#: per-split draw (tests/test_data.py pins the property).
+SIGNAL_STREAM = 0xC1A55
+
+
+def signal_rng(seed: int) -> np.random.Generator:
+    """RNG for a synthetic dataset's split-independent class signal."""
+    return np.random.default_rng(np.random.SeedSequence([seed, SIGNAL_STREAM]))
+
+
 def partition_indices(
     n: int, rank: int, nworkers: int, seed: int = 0, epoch: int = 0
 ) -> np.ndarray:

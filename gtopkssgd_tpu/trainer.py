@@ -131,6 +131,24 @@ class TrainState(NamedTuple):
     opt_state: Any
 
 
+def shard_steps_per_epoch(ds, batch_size: int, nsteps_update: int = 1) -> int:
+    """Optimizer steps per epoch for a rank's dataset shard.
+
+    Must be identical on EVERY process of a multi-host run (each step
+    issues collectives; disagreement desyncs the SPMD program). The
+    partitioner gives the last rank the dataset remainder, so the count is
+    derived from the MINIMUM shard size — a pure function of
+    (n, nworkers, batch_size) every process agrees on — rather than from
+    whichever shard happens to be local. Shared by the Trainer and the
+    convergence runner so max_epochs-from-steps arithmetic cannot drift
+    from the LR schedule's epoch length."""
+    spe = ds.steps_per_epoch()
+    part = getattr(ds, "partitioner", None)
+    if part is not None and part.nworkers > 1:
+        spe = (part.n // part.nworkers) // batch_size
+    return max(1, spe // nsteps_update)
+
+
 class Trainer:
     def __init__(self, config: TrainConfig):
         self.cfg = cfg = config.resolved()
@@ -162,17 +180,9 @@ class Trainer:
             for r in self.local_ranks
         ]
         self.val_data = get_dataset(cfg.dataset, split="test", **data_kw)
-        # steps_per_epoch must be identical on EVERY process of a multi-host
-        # run (each step issues collectives; disagreement desyncs the SPMD
-        # program). The partitioner gives the last rank the dataset
-        # remainder, so derive the count from the MINIMUM shard size —
-        # a pure function of (n, nworkers, batch_size) every process agrees
-        # on — rather than from whichever shard happens to be local.
-        spe = self.train_shards[0].steps_per_epoch()
-        part = getattr(self.train_shards[0], "partitioner", None)
-        if part is not None and part.nworkers > 1:
-            spe = (part.n // part.nworkers) // cfg.batch_size
-        self.steps_per_epoch = max(1, spe // cfg.nsteps_update)
+        self.steps_per_epoch = shard_steps_per_epoch(
+            self.train_shards[0], cfg.batch_size, cfg.nsteps_update
+        )
 
         self.tx = gtopk_sgd(
             self._lr_schedule(),

@@ -95,3 +95,61 @@ def test_an4_synthetic_ctc_batches():
     assert (batch["input_lengths"] <= t).all()
     assert (batch["label_lengths"] > 0).all()
     assert (batch["labels"] < ds.num_chars).all()
+
+
+def test_synthetic_class_signal_shared_across_splits():
+    """Train and held-out synthetic data must carry the SAME class signal —
+    otherwise eval on synthetic runs is structurally chance-level (the bug
+    this pins: offsets/signatures were drawn from split-specific streams).
+    """
+    from gtopkssgd_tpu.data.an4 import _synth_utterances
+    from gtopkssgd_tpu.data.cifar import _synthetic
+
+    # CIFAR: per-class mean color of train vs test must agree per class.
+    for seed in (0, 7):
+        tr_img, tr_lab = _synthetic("train", seed)
+        te_img, te_lab = _synthetic("test", seed)
+        tr_mean = np.stack([
+            tr_img[tr_lab == c].mean(axis=(0, 1, 2)) for c in range(10)
+        ])  # [10, 3]
+        te_mean = np.stack([
+            te_img[te_lab == c].mean(axis=(0, 1, 2)) for c in range(10)
+        ])
+        # Every class's train-mean color is closest to the SAME class's
+        # test-mean color.
+        d = np.linalg.norm(tr_mean[:, None, :] - te_mean[None, :, :], axis=-1)
+        assert (d.argmin(axis=1) == np.arange(10)).all()
+
+    # ImageNet: the class-offset table itself must be identical.
+    from gtopkssgd_tpu.data.imagenet import ImageNetDataset
+
+    tr = ImageNetDataset(split="train", batch_size=2, num_classes=16,
+                         image_size=32, seed=3)
+    te = ImageNetDataset(split="val", batch_size=2, num_classes=16,
+                         image_size=32, seed=3)
+    assert tr.synthetic and te.synthetic
+    np.testing.assert_array_equal(tr._offsets, te._offsets)
+
+    # AN4: per-char spectral signature direction must correlate across
+    # splits (utterance noise differs; the char->spectrum mapping must not).
+    tr_utts = _synth_utterances("train", 5, 29)
+    te_utts = _synth_utterances("test", 5, 29)
+
+    def char_means(utts):
+        acc = {c: [] for c in range(1, 29)}
+        for u in utts[:64]:
+            L = len(u["labels"])
+            fp = u["spec"].shape[0] // L
+            for j, ch in enumerate(u["labels"]):
+                acc[int(ch)].append(u["spec"][j * fp:(j + 1) * fp].mean(0))
+        return {c: np.mean(v, axis=0) for c, v in acc.items() if v}
+
+    tm, em = char_means(tr_utts), char_means(te_utts)
+    common = sorted(set(tm) & set(em))
+    assert len(common) >= 20
+    cos = [
+        float(np.dot(tm[c], em[c])
+              / (np.linalg.norm(tm[c]) * np.linalg.norm(em[c]) + 1e-9))
+        for c in common
+    ]
+    assert np.mean(cos) > 0.5, np.mean(cos)
