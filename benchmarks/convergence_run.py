@@ -31,7 +31,6 @@ Writes benchmarks/results/convergence_<dnn>_<device>.jsonl
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import math
 import os
@@ -44,7 +43,31 @@ import jax
 THRESHOLD_FRACS = (0.5, 0.2, 0.1, 0.02)
 
 
-def run_mode(args, mode: str, density: float):
+def max_epochs_for(args) -> int:
+    """Epochs the --steps budget spans — mode-independent, computed ONCE.
+
+    max_epochs drives the LR schedule; leaving it at 1 for a multi-epoch
+    fixed-step run would degenerate the CIFAR decay boundaries to step 0
+    (constant LR). steps_per_epoch is pure shard arithmetic — one rank-0
+    dataset through the SAME helper the Trainer uses
+    (trainer.py::shard_steps_per_epoch), no throwaway Trainer build.
+    """
+    from gtopkssgd_tpu.data import get_dataset
+    from gtopkssgd_tpu.trainer import TrainConfig, shard_steps_per_epoch
+
+    rcfg = TrainConfig(
+        dnn=args.dnn, batch_size=args.batch_size,
+        nworkers=args.nworkers or jax.device_count(),
+        data_dir=args.data_dir,
+    ).resolved()
+    ds = get_dataset(rcfg.dataset, split="train", batch_size=rcfg.batch_size,
+                     rank=0, nworkers=rcfg.nworkers,
+                     data_dir=rcfg.data_dir or None, seed=args.seed)
+    spe = shard_steps_per_epoch(ds, rcfg.batch_size, rcfg.nsteps_update)
+    return max(1, math.ceil(args.steps / spe))
+
+
+def run_mode(args, mode: str, density: float, max_epochs: int):
     """Train one mode; returns (curve_rows, summary) — steps-to-threshold
     is computed later in main() against the shared reference."""
     from gtopkssgd_tpu.trainer import TrainConfig, Trainer
@@ -57,28 +80,11 @@ def run_mode(args, mode: str, density: float):
         compression=mode,
         density=density,
         seed=args.seed,
-        max_epochs=1,
+        max_epochs=max_epochs,
         log_interval=10_000_000,  # curve sampling happens here, not in logs
         eval_batches=args.eval_batches,
         data_dir=args.data_dir,
     )
-    # max_epochs drives the LR schedule; with a fixed --steps budget the run
-    # spans steps/steps_per_epoch epochs, and leaving max_epochs=1 would
-    # degenerate the CIFAR decay boundaries to step 0 (constant LR).
-    # steps_per_epoch is pure shard arithmetic — compute it from one rank-0
-    # dataset with the SAME helper the Trainer uses (trainer.py::
-    # shard_steps_per_epoch) instead of paying a throwaway Trainer build.
-    from gtopkssgd_tpu.data import get_dataset
-    from gtopkssgd_tpu.trainer import shard_steps_per_epoch
-
-    rcfg = cfg.resolved()
-    ds = get_dataset(rcfg.dataset, split="train", batch_size=cfg.batch_size,
-                     rank=0, nworkers=cfg.nworkers,
-                     data_dir=cfg.data_dir or None, seed=cfg.seed)
-    spe = shard_steps_per_epoch(ds, cfg.batch_size, rcfg.nsteps_update)
-    cfg = dataclasses.replace(
-        cfg, max_epochs=max(1, math.ceil(args.steps / spe)))
-
     curve, losses = [], []
     with Trainer(cfg) as trainer:
         done = 0
@@ -143,12 +149,13 @@ def main():
     from gtopkssgd_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()
+    epochs = max_epochs_for(args)
     curves, summaries = {}, []
     for mode in args.modes.split(","):
         mode = mode.strip()
         print(f"[convergence] {args.dnn} {mode} rho={args.density} "
-              f"steps={args.steps}", flush=True)
-        curve, summary = run_mode(args, mode, args.density)
+              f"steps={args.steps} epochs={epochs}", flush=True)
+        curve, summary = run_mode(args, mode, args.density, epochs)
         curves[mode] = curve
         summaries.append(summary)
 

@@ -36,3 +36,17 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def load_benchmark_module(name: str):
+    """Import benchmarks/<name>.py by path (benchmarks/ is not a package
+    on sys.path for the test run). Shared by the tests that pin the
+    benchmark harnesses so the loader boilerplate cannot drift."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -9,17 +9,11 @@ so the committed results remain reproducible.
 import json
 import sys
 
+from tests.conftest import load_benchmark_module
+
 
 def _load_runner():
-    import importlib.util
-    import os
-
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "benchmarks", "convergence_run.py")
-    spec = importlib.util.spec_from_file_location("convergence_run", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    return load_benchmark_module("convergence_run")
 
 
 def test_convergence_runner_end_to_end(tmp_path, monkeypatch):
