@@ -42,11 +42,27 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 
-import jax
+
+def _fail_fast_if_backend_dead(timeout_s: float = 180.0) -> None:
+    """Exit with a diagnostic instead of hanging when the TPU tunnel is
+    down: backend init blocks forever inside PJRT client creation in that
+    state (observed when the axon relay died mid-round), which would hang
+    the driver's bench step. The shared subprocess probe bounds the wait."""
+    from gtopkssgd_tpu.utils import backend_responsive
+
+    if backend_responsive(timeout_s):
+        return
+    print("bench.py: accelerator backend unavailable (init did not "
+          f"complete within {timeout_s:.0f}s); refusing to hang — fix the "
+          "device tunnel and re-run", file=sys.stderr)
+    raise SystemExit(3)
 
 
 def main():
+    _fail_fast_if_backend_dead()
+    import jax
     from gtopkssgd_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()

@@ -12,7 +12,11 @@ from gtopkssgd_tpu.utils.timers import (
 )
 from gtopkssgd_tpu.utils.metrics import MetricsLogger
 from gtopkssgd_tpu.utils.checkpoint import CheckpointManager
-from gtopkssgd_tpu.utils.settings import enable_compilation_cache, get_logger
+from gtopkssgd_tpu.utils.settings import (
+    backend_responsive,
+    enable_compilation_cache,
+    get_logger,
+)
 from gtopkssgd_tpu.utils.prefetch import Prefetcher
 
 __all__ = [
@@ -25,5 +29,6 @@ __all__ = [
     "CheckpointManager",
     "get_logger",
     "enable_compilation_cache",
+    "backend_responsive",
     "Prefetcher",
 ]

@@ -35,6 +35,38 @@ def enable_compilation_cache(
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+def backend_responsive(timeout_s: float = 150.0) -> bool:
+    """Can this process's jax backend initialize within ``timeout_s``?
+
+    On a tunneled accelerator, backend init BLOCKS FOREVER inside PJRT
+    client creation when the tunnel is down (observed: ``make_c_api_client``
+    hung indefinitely after the relay died), so probing
+    ``jax.device_count()`` in-process can hang the caller. The probe runs
+    in a subprocess with a timeout instead. It replicates the parent's
+    platform pin via the config API — the machine's sitecustomize overrides
+    the ``JAX_PLATFORMS`` env var, so a CPU-pinned parent (tests, CI mesh)
+    must not have its probe grab the exclusive-access real device.
+    Importing jax does NOT initialize a backend; this helper is safe to
+    call before any device use. Used by bench.py and
+    __graft_entry__.dryrun_multichip so the hang-avoidance logic cannot
+    drift between the two driver entry points.
+    """
+    import subprocess
+
+    import jax
+
+    plats = jax.config.jax_platforms
+    pin = (f"jax.config.update('jax_platforms', {plats!r})\n"
+           if plats else "")
+    code = f"import jax\n{pin}print(jax.device_count())"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, timeout=timeout_s)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 _FMT = "%(asctime)s [%(name)s:r{rank}] %(levelname)s %(message)s"
 
 
