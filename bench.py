@@ -49,14 +49,17 @@ def _fail_fast_if_backend_dead(timeout_s: float = 180.0) -> None:
     """Exit with a diagnostic instead of hanging when the TPU tunnel is
     down: backend init blocks forever inside PJRT client creation in that
     state (observed when the axon relay died mid-round), which would hang
-    the driver's bench step. The shared subprocess probe bounds the wait."""
-    from gtopkssgd_tpu.utils import backend_responsive
+    the driver's bench step. The shared watchdog-deadline init bounds the
+    wait at zero extra cost on the healthy path; a backend that
+    initializes but ERRORS returns normally here and the real error
+    surfaces from main()'s own first jax call."""
+    from gtopkssgd_tpu.utils import init_backend_with_deadline
 
-    if backend_responsive(timeout_s):
+    if init_backend_with_deadline(timeout_s):
         return
-    print("bench.py: accelerator backend unavailable (init did not "
-          f"complete within {timeout_s:.0f}s); refusing to hang — fix the "
-          "device tunnel and re-run", file=sys.stderr)
+    print(f"bench.py: accelerator backend init still blocked after "
+          f"{timeout_s:.0f}s (dead device tunnel?); refusing to hang — "
+          "fix the tunnel and re-run", file=sys.stderr)
     raise SystemExit(3)
 
 

@@ -13,9 +13,9 @@ from gtopkssgd_tpu.utils.timers import (
 from gtopkssgd_tpu.utils.metrics import MetricsLogger
 from gtopkssgd_tpu.utils.checkpoint import CheckpointManager
 from gtopkssgd_tpu.utils.settings import (
-    backend_responsive,
     enable_compilation_cache,
     get_logger,
+    init_backend_with_deadline,
 )
 from gtopkssgd_tpu.utils.prefetch import Prefetcher
 
@@ -29,6 +29,6 @@ __all__ = [
     "CheckpointManager",
     "get_logger",
     "enable_compilation_cache",
-    "backend_responsive",
+    "init_backend_with_deadline",
     "Prefetcher",
 ]

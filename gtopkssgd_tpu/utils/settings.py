@@ -35,36 +35,46 @@ def enable_compilation_cache(
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-def backend_responsive(timeout_s: float = 150.0) -> bool:
-    """Can this process's jax backend initialize within ``timeout_s``?
+def init_backend_with_deadline(timeout_s: float = 150.0) -> bool:
+    """Initialize THIS process's jax backend, but give up after a deadline.
 
     On a tunneled accelerator, backend init BLOCKS FOREVER inside PJRT
     client creation when the tunnel is down (observed: ``make_c_api_client``
-    hung indefinitely after the relay died), so probing
-    ``jax.device_count()`` in-process can hang the caller. The probe runs
-    in a subprocess with a timeout instead. It replicates the parent's
-    platform pin via the config API — the machine's sitecustomize overrides
-    the ``JAX_PLATFORMS`` env var, so a CPU-pinned parent (tests, CI mesh)
-    must not have its probe grab the exclusive-access real device.
-    Importing jax does NOT initialize a backend; this helper is safe to
-    call before any device use. Used by bench.py and
-    __graft_entry__.dryrun_multichip so the hang-avoidance logic cannot
-    drift between the two driver entry points.
+    hung indefinitely after the relay died), so a bare
+    ``jax.device_count()`` can hang the caller with no recourse. This runs
+    the init on a daemon thread and waits up to ``timeout_s``:
+
+      * already-initialized backend → returns True immediately (no cost,
+        no contention — in particular no second process fighting the
+        parent for an exclusive-access device, which a subprocess probe
+        would);
+      * healthy cold init → pays the one init the caller needed anyway;
+      * init ERROR → returns True quickly; the caller's next jax call
+        surfaces the real error text (not a misleading timeout message);
+      * hung init → returns False at the deadline; the blocked daemon
+        thread cannot be cancelled, so the caller should fall back to a
+        path that avoids this backend (CPU re-exec) or exit promptly.
+
+    Used by bench.py and __graft_entry__.dryrun_multichip so the
+    hang-avoidance logic cannot drift between the two driver entry points.
     """
-    import subprocess
+    import threading
 
     import jax
 
-    plats = jax.config.jax_platforms
-    pin = (f"jax.config.update('jax_platforms', {plats!r})\n"
-           if plats else "")
-    code = f"import jax\n{pin}print(jax.device_count())"
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, timeout=timeout_s)
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    done = threading.Event()
+
+    def _init():
+        try:
+            jax.device_count()
+        except Exception:
+            pass  # caller's own jax use will raise the real error
+        finally:
+            done.set()
+
+    threading.Thread(target=_init, daemon=True,
+                     name="jax-backend-init-watchdog").start()
+    return done.wait(timeout_s)
 
 
 _FMT = "%(asctime)s [%(name)s:r{rank}] %(levelname)s %(message)s"
