@@ -67,9 +67,15 @@ def max_epochs_for(args) -> int:
     return max(1, math.ceil(args.steps / spe))
 
 
-def run_mode(args, mode: str, density: float, max_epochs: int):
+def run_mode(args, mode: str, density: float, max_epochs: int,
+             stream=None):
     """Train one mode; returns (curve_rows, summary) — steps-to-threshold
-    is computed later in main() against the shared reference."""
+    is computed later in main() against the shared reference. When
+    ``stream`` is given, every curve row is also appended+flushed to it as
+    it is measured: a multi-mode run is tens of minutes of compute, and a
+    timeout/preemption mid-run must not lose the modes already measured
+    (learned the hard way — a 50-minute 3-mode run died in mode 3 with
+    nothing on disk)."""
     from gtopkssgd_tpu.trainer import TrainConfig, Trainer
 
     density = 1.0 if mode in ("dense", "none") else density
@@ -93,11 +99,15 @@ def run_mode(args, mode: str, density: float, max_epochs: int):
             stats = trainer.train(n)
             done += n
             losses.append(stats["loss"])
-            curve.append({
+            row = {
                 "mode": mode, "density": density, "step": done,
                 "loss": round(stats["loss"], 5),
                 "throughput": round(stats["throughput"], 1),
-            })
+            }
+            curve.append(row)
+            if stream is not None:
+                stream.write(json.dumps(row) + "\n")
+                stream.flush()
             print(f"  {mode:10s} step {done:5d}  loss {stats['loss']:.4f}",
                   flush=True)
         ev = trainer.test()
@@ -150,48 +160,53 @@ def main():
 
     enable_compilation_cache()
     epochs = max_epochs_for(args)
-    curves, summaries = {}, []
-    for mode in args.modes.split(","):
-        mode = mode.strip()
-        print(f"[convergence] {args.dnn} {mode} rho={args.density} "
-              f"steps={args.steps} epochs={epochs}", flush=True)
-        curve, summary = run_mode(args, mode, args.density, epochs)
-        curves[mode] = curve
-        summaries.append(summary)
-
-    # One shared absolute reference for the thresholds: the dense curve's
-    # first sample when present (the baseline every mode is judged against),
-    # else the max across modes (so no mode gets an easier target).
-    dense = next((s for s in summaries if s["mode"] in ("dense", "none")),
-                 None)
-    firsts = {m: c[0]["loss"] for m, c in curves.items() if c}
-    ref = firsts.get(dense["mode"]) if dense else None
-    if ref is None:
-        ref = max(firsts.values())
-    for s in summaries:
-        s.update(steps_to_thresholds(curves[s["mode"]], ref))
-        if dense is not None:
-            s["final_loss_vs_dense"] = round(
-                s["final_loss"] / max(dense["final_loss"], 1e-9), 4)
-
-    report = {"dnn": args.dnn, "steps": args.steps,
-              "batch_size": args.batch_size,
-              "device_kind": jax.devices()[0].device_kind,
-              "nworkers": args.nworkers or jax.device_count(),
-              "threshold_reference_loss": round(ref, 5),
-              "modes": summaries}
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "results",
         f"convergence_{args.dnn}_"
         f"{jax.devices()[0].device_kind.replace(' ', '_')}.jsonl",
     )
-    with open(out, "w") as fh:
-        for curve in curves.values():
-            for r in curve:
-                fh.write(json.dumps(r) + "\n")
+    # Stream to a .partial sibling and rename on success: crash-durability
+    # for THIS run's rows without truncating a previous complete artifact
+    # at time zero (a re-run that dies in mode 1 must not destroy the last
+    # good capture).
+    partial = out + ".partial"
+    curves, summaries = {}, []
+    with open(partial, "w") as fh:
+        for mode in args.modes.split(","):
+            mode = mode.strip()
+            print(f"[convergence] {args.dnn} {mode} rho={args.density} "
+                  f"steps={args.steps} epochs={epochs}", flush=True)
+            curve, summary = run_mode(args, mode, args.density, epochs,
+                                      stream=fh)
+            curves[mode] = curve
+            summaries.append(summary)
+
+        # One shared absolute reference for the thresholds: the dense
+        # curve's first sample when present (the baseline every mode is
+        # judged against), else the max across modes (so no mode gets an
+        # easier target).
+        dense = next(
+            (s for s in summaries if s["mode"] in ("dense", "none")), None)
+        firsts = {m: c[0]["loss"] for m, c in curves.items() if c}
+        ref = firsts.get(dense["mode"]) if dense else None
+        if ref is None:
+            ref = max(firsts.values())
+        for s in summaries:
+            s.update(steps_to_thresholds(curves[s["mode"]], ref))
+            if dense is not None:
+                s["final_loss_vs_dense"] = round(
+                    s["final_loss"] / max(dense["final_loss"], 1e-9), 4)
+
+        report = {"dnn": args.dnn, "steps": args.steps,
+                  "batch_size": args.batch_size,
+                  "device_kind": jax.devices()[0].device_kind,
+                  "nworkers": args.nworkers or jax.device_count(),
+                  "threshold_reference_loss": round(ref, 5),
+                  "modes": summaries}
         for s in summaries:
             fh.write(json.dumps({**s, "kind": "summary"}) + "\n")
         fh.write(json.dumps({**report, "kind": "report"}) + "\n")
+    os.replace(partial, out)
     print(json.dumps(report))
 
 

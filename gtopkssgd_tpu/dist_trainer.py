@@ -62,6 +62,11 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--nsteps-update", type=int, default=1,
                    help="gradient accumulation micro-steps per comm round")
     p.add_argument("--max-epochs", type=int, default=140)
+    p.add_argument("--warmup-epochs", type=int, default=0,
+                   help="linear LR ramp over the first N epochs")
+    p.add_argument("--dense-warmup-epochs", type=int, default=0,
+                   help="sparse modes: communicate dense for the first N "
+                        "epochs before enabling top-k (warm-up training)")
     p.add_argument("--nworkers", type=int, default=0,
                    help="mesh size (0 = all visible devices)")
     p.add_argument("--data-dir", default=None)
@@ -103,6 +108,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         topk_method=args.topk_method,
         clip_grad_norm=args.clip_grad_norm,
         nsteps_update=args.nsteps_update,
+        warmup_epochs=args.warmup_epochs,
+        dense_warmup_epochs=args.dense_warmup_epochs,
         max_epochs=args.max_epochs,
         nworkers=nworkers,
         data_dir=args.data_dir,

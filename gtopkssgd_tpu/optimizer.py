@@ -74,6 +74,7 @@ def gtopk_sgd(
     axis_name: Optional[str] = "dp",
     axis_size: Optional[int] = None,
     hier_ici_size: int = 1,
+    warmup_dense_steps: int = 0,
 ) -> optax.GradientTransformation:
     """Build the distributed gTop-k S-SGD gradient transformation.
 
@@ -96,6 +97,20 @@ def gtopk_sgd(
     cannot silently disagree with the mesh; ``axis_size``, if given, is only
     validated against it.
 
+    ``warmup_dense_steps`` (reference C6 parity: the warm-up trick in
+    settings.py — DGC-lineage "warm-up training", arXiv:1712.01887 §3)
+    communicates the DENSE averaged gradient for the first W optimizer
+    steps of a sparse mode, then switches to the sparse pipeline. Top-k
+    at rho=0.001 updates only k coordinates per step, so cold-starting
+    sparse costs a long accuracy ramp (measured: an 8-way gtopk run at
+    600 steps trails dense 2.0-vs-0.2 in loss purely from the ramp); a
+    few dense epochs remove it. Implemented as a ``lax.cond`` on the step
+    counter INSIDE the one jitted update, so state shapes are identical
+    in both phases, there is no recompile at the boundary, and
+    checkpoint/resume lands in the right phase automatically. The
+    residual passes through the dense phase unchanged (zeros), so error
+    feedback starts exactly at the switch.
+
     ``compression='gtopk_hier'`` enables the two-level TPU-idiom reduction
     (not reference parity — SURVEY.md §5 design option): the raw gradient is
     first dense-psum'd WITHIN each contiguous block of ``hier_ici_size``
@@ -115,6 +130,10 @@ def gtopk_sgd(
         raise ValueError(
             f"hier_ici_size={hier_ici_size} only applies to hierarchical "
             f"modes {HIER_MODES}, not {mode!r}"
+        )
+    if warmup_dense_steps < 0:
+        raise ValueError(
+            f"warmup_dense_steps must be >= 0, got {warmup_dense_steps}"
         )
     if nesterov and not momentum:
         # torch.optim.SGD raises here too; silently running plain SGD while
@@ -189,26 +208,47 @@ def gtopk_sgd(
             dense = reduced / p
             residual = state.residual
         else:
-            acc = compressor.accumulate(flat, state.residual)
-            vals, idx, residual = compressor.compress(acc)
-            if p == 1:
-                # No collective at p=1, so the dense update is exactly
-                # acc - residual' (selected entries keep their acc value,
-                # everything else cancels to 0.0 bit-exactly) — an
-                # elementwise op XLA fuses into the surrounding chain,
-                # instead of materializing a zeros(N) + scatter.
-                dense = acc - residual
-            else:
-                result, gidx, needs_repair = sparse_allreduce(
-                    mode, vals, idx, k=compressor.k(n), n=n,
-                    axis_name=axis_name, axis_size=p,
-                    ici_size=hier_ici_size if hier else 1,
+            def sparse_branch(flat, residual_in):
+                acc = compressor.accumulate(flat, residual_in)
+                vals, idx, residual = compressor.compress(acc)
+                if p == 1:
+                    # No collective at p=1, so the dense update is exactly
+                    # acc - residual' (selected entries keep their acc
+                    # value, everything else cancels to 0.0 bit-exactly) —
+                    # an elementwise op XLA fuses into the surrounding
+                    # chain, instead of materializing a zeros(N) + scatter.
+                    dense = acc - residual
+                else:
+                    result, gidx, needs_repair = sparse_allreduce(
+                        mode, vals, idx, k=compressor.k(n), n=n,
+                        axis_name=axis_name, axis_size=p,
+                        ici_size=hier_ici_size if hier else 1,
+                    )
+                    if needs_repair:  # gtopk: sparse set + repair
+                        residual = compressor.repair(
+                            residual, vals, idx, gidx)
+                        dense = scatter_add_dense(n, gidx, result) / p
+                    else:  # allgather union: dense, every pick lands
+                        dense = result / p
+                return dense, residual
+
+            if warmup_dense_steps > 0:
+                def dense_branch(flat, residual_in):
+                    reduced = lax.psum(flat, axis_name) if p > 1 else flat
+                    # In hier mode `flat` is already the within-slice SUM
+                    # (ici_dense_psum above), so a full-axis psum counts
+                    # every original gradient hier_ici_size times — divide
+                    # it back out or every warm-up step trains at an
+                    # ici_size-inflated effective LR.
+                    scale = p * (hier_ici_size if (hier and p > 1) else 1)
+                    return reduced / scale, residual_in
+
+                dense, residual = lax.cond(
+                    state.count < warmup_dense_steps,
+                    dense_branch, sparse_branch, flat, state.residual,
                 )
-                if needs_repair:  # gtopk: sparse (gvals, gidx) + repair
-                    residual = compressor.repair(residual, vals, idx, gidx)
-                    dense = scatter_add_dense(n, gidx, result) / p
-                else:  # allgather union: dense result, every pick lands
-                    dense = result / p
+            else:
+                dense, residual = sparse_branch(flat, state.residual)
 
         avg_grads = unravel(dense)
         updates, inner_state = inner.update(avg_grads, state.inner, params)

@@ -77,6 +77,12 @@ class TrainConfig:
     topk_method: str = "auto"
     clip_grad_norm: Optional[float] = None  # default: LSTMs clip (ref §3.4)
     nsteps_update: int = 1
+    warmup_epochs: int = 0         # linear LR ramp over the first N epochs
+                                   # (large-batch warm-up, Goyal-style)
+    dense_warmup_epochs: int = 0   # sparse modes: communicate DENSE for the
+                                   # first N epochs, then switch to top-k
+                                   # (reference C6 warm-up trick / DGC
+                                   # warm-up training, arXiv:1712.01887)
     max_epochs: int = 140
     nworkers: int = 1
     data_dir: Optional[str] = None
@@ -195,6 +201,7 @@ class Trainer:
             clip_grad_norm=cfg.clip_grad_norm,
             axis_name="dp" if self.p > 1 else None,
             hier_ici_size=cfg.hier_ici,
+            warmup_dense_steps=cfg.dense_warmup_epochs * self.steps_per_epoch,
         )
         self.state, self.carry = self._init_state()
         self._train_step = self._build_train_step()
@@ -252,10 +259,27 @@ class Trainer:
     def _lr_schedule(self):
         """Per-dataset step schedules, parity with the reference's hardcoded
         DLTrainer schedules (exact reference epochs unverifiable — mount was
-        empty; these are the standard recipes the paper's setup implies)."""
+        empty; these are the standard recipes the paper's setup implies).
+        ``warmup_epochs`` prepends a linear ramp from base/10 to base
+        (large-batch warm-up, the reference C6 settings.py warmup knob)."""
         cfg = self.cfg
         spe = self.steps_per_epoch
         base = cfg.lr
+        if cfg.warmup_epochs > 0:
+            w = cfg.warmup_epochs * spe
+            inner = self._dataset_schedule(base, spe)
+            inner_fn = (inner if callable(inner)
+                        else (lambda step, v=inner: v))
+
+            def schedule(step):
+                ramp = base * (0.1 + 0.9 * jnp.minimum(step, w) / w)
+                return jnp.where(step < w, ramp, inner_fn(step))
+
+            return schedule
+        return self._dataset_schedule(base, spe)
+
+    def _dataset_schedule(self, base, spe):
+        cfg = self.cfg
         if cfg.dataset == "cifar10":
             # x0.1 at 50% and 75% of training (classic CIFAR recipe). For
             # tiny max_epochs the two boundaries can collide or land at

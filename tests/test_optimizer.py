@@ -173,3 +173,69 @@ def test_state_is_checkpointable_pytree():
     u1, _ = tx.update(g, state, params)
     u2, _ = tx.update(g, state2, params)
     jax.tree.map(np.testing.assert_array_equal, u1, u2)
+
+
+def test_dense_warmup_matches_dense_then_switches():
+    """warmup_dense_steps=W (reference C6 warm-up trick): the first W steps
+    of a sparse mode are bit-equal to the dense baseline with the residual
+    untouched (zeros); step W switches to the sparse pipeline and error
+    feedback begins."""
+    n, W = 40, 2
+    params = {"w": jnp.zeros((n,))}
+    mesh = make_mesh(PDEV)
+    rng = np.random.default_rng(7)
+    grads = {"w": jnp.asarray(
+        rng.standard_normal((PDEV, n)).astype(np.float32))}
+
+    tx_w = gtopk_sgd(0.1, momentum=0.9, compression="gtopk", density=0.1,
+                     axis_name="dp", axis_size=PDEV, warmup_dense_steps=W)
+    tx_d = gtopk_sgd(0.1, momentum=0.9, compression="dense",
+                     axis_name="dp", axis_size=PDEV)
+    sw, sd = jax.jit(tx_w.init)(params), jax.jit(tx_d.init)(params)
+    step_w, step_d = _spmd_step(tx_w, mesh), _spmd_step(tx_d, mesh)
+
+    pw, pd = params, params
+    for i in range(W):
+        pw, sw = step_w(pw, sw, grads)
+        pd, sd = step_d(pd, sd, grads)
+        np.testing.assert_allclose(np.asarray(pw["w"]), np.asarray(pd["w"]),
+                                   rtol=1e-6, atol=1e-7)
+        assert not np.any(np.asarray(sw.residual)), f"residual dirty at {i}"
+
+    # Step W: sparse pipeline activates. With momentum the dense-phase
+    # buffer keeps every coordinate moving, so the sparse selection is
+    # asserted via the residual: k = 10% of n coords selected => at least
+    # the other 90% of the accumulated gradient mass lands in the residual.
+    pw, sw = step_w(pw, sw, grads)
+    assert np.any(np.asarray(sw.residual)), "error feedback never started"
+    assert (np.abs(np.asarray(sw.residual)) > 0).sum() >= n - int(n * 0.1)
+
+
+def test_warmup_rejected_for_negative():
+    import pytest
+
+    with pytest.raises(ValueError):
+        gtopk_sgd(0.1, compression="gtopk", warmup_dense_steps=-1)
+
+
+def test_dense_warmup_hier_matches_dense_scale():
+    """Regression: in gtopk_hier mode the warm-up dense branch receives the
+    SLICE-SUMMED gradient, so a full-axis psum over-counts by ici_size —
+    the warm-up step must still equal the plain dense baseline exactly."""
+    n = 40
+    params = {"w": jnp.zeros((n,))}
+    mesh = make_mesh(PDEV)
+    rng = np.random.default_rng(11)
+    grads = {"w": jnp.asarray(
+        rng.standard_normal((PDEV, n)).astype(np.float32))}
+
+    tx_h = gtopk_sgd(0.1, momentum=0.0, compression="gtopk_hier",
+                     density=0.1, axis_name="dp", axis_size=PDEV,
+                     hier_ici_size=4, warmup_dense_steps=1)
+    tx_d = gtopk_sgd(0.1, momentum=0.0, compression="dense",
+                     axis_name="dp", axis_size=PDEV)
+    sh, sd = jax.jit(tx_h.init)(params), jax.jit(tx_d.init)(params)
+    ph, _ = _spmd_step(tx_h, mesh)(params, sh, grads)
+    pd, _ = _spmd_step(tx_d, mesh)(params, sd, grads)
+    np.testing.assert_allclose(np.asarray(ph["w"]), np.asarray(pd["w"]),
+                               rtol=1e-5, atol=1e-6)

@@ -165,3 +165,39 @@ def test_imagenet_uint8_wire_trains_one_step():
         assert np.isfinite(stats["loss"]), stats
         ev = t.test()
         assert np.isfinite(ev["val_loss"]) and "val_top5" in ev
+
+
+def test_dense_warmup_and_lr_ramp_cross_boundary():
+    """Warm-up knobs (reference C6 settings.py): dense-communication phase
+    for the first N epochs of a sparse run, plus a linear LR ramp — one
+    jitted step covers both phases (no recompile at the switch), and the
+    residual stays zeros until the sparse phase begins."""
+    t = Trainer(small_cfg(
+        nworkers=4, compression="gtopk", density=0.01, batch_size=4,
+        dense_warmup_epochs=1, warmup_epochs=1, max_epochs=4,
+    ))
+    spe = t.steps_per_epoch
+    # LR ramp: base/10 at step 0, base at the end of warmup.
+    sched = t._lr_schedule()
+    base = t.cfg.lr
+    np.testing.assert_allclose(float(sched(0)), 0.1 * base, rtol=1e-5)
+    assert float(sched(spe // 2)) < base
+    np.testing.assert_allclose(float(sched(spe)), base, rtol=1e-5)
+
+    # Train across the warmup boundary in one Trainer (same jit).
+    t.train(spe)  # dense-communication phase
+    res_warm = np.asarray(t.state.opt_state.residual)
+    assert not res_warm.any(), "residual must stay zero during dense warmup"
+    stats = t.train(2)  # sparse phase begins
+    assert np.isfinite(stats["loss"])
+    assert np.asarray(t.state.opt_state.residual).any(), (
+        "error feedback should start after warmup"
+    )
+
+
+def test_warmup_cli_flags():
+    args = build_argparser().parse_args([
+        "--warmup-epochs", "2", "--dense-warmup-epochs", "3",
+    ])
+    cfg = config_from_args(args)
+    assert cfg.warmup_epochs == 2 and cfg.dense_warmup_epochs == 3
