@@ -40,6 +40,15 @@ EXPERIMENTS: Dict[str, Dict[str, Any]] = {
         _desc="ResNet-20/CIFAR-10, 4-worker gTop-k rho=0.001",
         _baseline="#2",
     ),
+    "cifar10_resnet20_gtopk_warmup": dict(
+        dnn="resnet20", batch_size=128, nworkers=4, compression="gtopk",
+        density=0.001, max_epochs=140, warmup_epochs=4,
+        dense_warmup_epochs=4,
+        _desc="ResNet-20/CIFAR-10, 4-worker gTop-k with the warm-up "
+              "trick (4 LR-ramp epochs + 4 dense-comm epochs before "
+              "top-k — removes the sparse cold-start ramp)",
+        _baseline="#2 warm-up variant",
+    ),
     "cifar10_resnet20_dense": dict(
         dnn="resnet20", batch_size=128, nworkers=4, compression="dense",
         density=1.0, max_epochs=140,
