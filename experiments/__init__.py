@@ -45,8 +45,9 @@ EXPERIMENTS: Dict[str, Dict[str, Any]] = {
         density=0.001, max_epochs=140, warmup_epochs=4,
         dense_warmup_epochs=4,
         _desc="ResNet-20/CIFAR-10, 4-worker gTop-k with the warm-up "
-              "trick (4 LR-ramp epochs + 4 dense-comm epochs before "
-              "top-k — removes the sparse cold-start ramp)",
+              "trick (epochs 0-3: LR ramps up AND communication stays "
+              "dense, concurrently; top-k starts at epoch 4 — removes "
+              "the sparse cold-start ramp)",
         _baseline="#2 warm-up variant",
     ),
     "cifar10_resnet20_dense": dict(

@@ -201,3 +201,39 @@ def test_warmup_cli_flags():
     ])
     cfg = config_from_args(args)
     assert cfg.warmup_epochs == 2 and cfg.dense_warmup_epochs == 3
+
+
+def test_fit_epoch_loop_with_checkpoint(tmp_path, monkeypatch):
+    """fit() (reference dist_trainer main loop): epoch-driven train + eval +
+    checkpoint each epoch; a fresh Trainer resumes into the NEXT epoch."""
+    from gtopkssgd_tpu.data import cifar
+
+    # Shrink the synthetic corpus so an epoch is 8 optimizer steps; a
+    # distinct seed keeps the lru_cached full-size corpus of other tests,
+    # and clearing the cache afterwards keeps the 128-sample corpus from
+    # leaking to any later test that happens to share the seed.
+    monkeypatch.setattr(cifar, "SYNTH_TRAIN", 128)
+    cifar._synthetic.cache_clear()
+    try:
+        _run_fit(tmp_path)
+    finally:
+        cifar._synthetic.cache_clear()
+
+
+def _run_fit(tmp_path):
+    cfg = small_cfg(
+        nworkers=4, batch_size=4, compression="gtopk", density=0.01,
+        max_epochs=2, eval_batches=1, out_dir=str(tmp_path), seed=123,
+    )
+    with Trainer(cfg) as t:
+        spe = t.steps_per_epoch
+        assert spe == 8
+        stats = t.fit()
+        assert int(t.state.step) == 2 * spe
+        assert np.isfinite(stats["loss"]) and "val_top1" in stats
+    with Trainer(cfg) as t2:
+        assert t2.restore()
+        assert int(t2.state.step) == 2 * spe
+        # fit() from a fully-trained checkpoint is a no-op, not a retrain.
+        t2.fit()
+        assert int(t2.state.step) == 2 * spe
