@@ -77,6 +77,9 @@ def main():
     ap.add_argument("--dtype", default="bfloat16",
                     choices=["float32", "bfloat16"])
     ap.add_argument("--topk-method", default="auto")
+    ap.add_argument("--compression", default="gtopk",
+                    help="sparse mode to benchmark against the dense "
+                         "baseline (gtopk | gtopk_layerwise | allgather)")
     args = ap.parse_args()
 
     from gtopkssgd_tpu.benchmark import BenchConfig, measure_throughput
@@ -86,7 +89,7 @@ def main():
         min_seconds=args.min_seconds, density=args.density,
         dtype=args.dtype, topk_method=args.topk_method,
     )
-    gtopk = measure_throughput(cfg, "gtopk", args.density)
+    gtopk = measure_throughput(cfg, args.compression, args.density)
     dense = measure_throughput(cfg, "dense", 1.0)
     p = jax.device_count()
 
@@ -94,8 +97,8 @@ def main():
         return round(v, nd) if isinstance(v, float) else v
 
     print(json.dumps({
-        "metric": f"{args.dnn}_gtopk_rho{args.density}_train_throughput"
-                  f"_{p}chip",
+        "metric": f"{args.dnn}_{args.compression}_rho{args.density}"
+                  f"_train_throughput_{p}chip",
         "value": round(gtopk["images_per_sec_per_chip"], 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(

@@ -106,6 +106,19 @@ EXPERIMENTS: Dict[str, Dict[str, Any]] = {
               "within slice, gTop-k across (TPU extension)",
         _baseline="extension",
     ),
+    # --- TPU extension (NOT reference parity): layer-wise selection -----
+    # Per-layer top-k_l + per-layer error feedback (arXiv:1911.08772
+    # lineage); the flat [N] gradient never materializes, un-serializing
+    # the selection from the backward epilogues. Same gTop-k hypercube on
+    # the wire.
+    "imagenet_resnet50_gtopk_layerwise": dict(
+        dnn="resnet50", batch_size=32, nworkers=16,
+        compression="gtopk_layerwise", density=0.001, max_epochs=90,
+        dtype="bfloat16",
+        _desc="ResNet-50/ImageNet, 16-worker layer-wise gTop-k rho=0.001 "
+              "(TPU extension)",
+        _baseline="extension",
+    ),
 }
 
 # BASELINE.json config #5 (density sweep) is a benchmark, not a training

@@ -167,9 +167,11 @@ def measure_throughput(cfg: BenchConfig, mode: Optional[str],
 
     def step(state, batch):
         params, bstats, opt_state = state
-        # residual is per-device [1, N] inside the block (same convention
-        # as the trainer) — strip for the transform, restore on the way out
-        opt_state = opt_state._replace(residual=opt_state.residual[0])
+        # residual is per-device [1, ...] inside the block (same convention
+        # as the trainer) — strip for the transform, restore on the way
+        # out; tree.map covers the layerwise per-leaf tuple too
+        opt_state = opt_state._replace(
+            residual=jax.tree.map(lambda r: r[0], opt_state.residual))
         xb, yb = jax.tree.map(lambda b: b[0], batch)
 
         def loss_fn(params):
@@ -188,7 +190,8 @@ def measure_throughput(cfg: BenchConfig, mode: Optional[str],
         (loss, nbs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        opt_state = opt_state._replace(residual=opt_state.residual[None])
+        opt_state = opt_state._replace(
+            residual=jax.tree.map(lambda r: r[None], opt_state.residual))
         return (params, nbs, opt_state), lax.pmean(loss, "dp")
 
     state_spec = (P(), P(), GTopKSGDState(count=P(), residual=P("dp"),
@@ -226,8 +229,18 @@ def measure_throughput(cfg: BenchConfig, mode: Optional[str],
 
     sec, steps = timed_window(chunk, rtt, cfg.min_seconds, 8)
 
+    from gtopkssgd_tpu.modes import LAYERWISE_MODES
+    from gtopkssgd_tpu.ops import k_for_density
+
     n = sum(a.size for a in jax.tree.leaves(params))
-    k = get_compressor(mode, density).k(n)
+    if mode in LAYERWISE_MODES:
+        # The wire K is the sum of per-leaf selections — the ceil() rounds
+        # every tiny leaf up to >= 1, so at low densities K can exceed the
+        # flat ceil(rho*N) severalfold and the comm model must match.
+        k = sum(k_for_density(a.size, density)
+                for a in jax.tree.leaves(params))
+    else:
+        k = get_compressor(mode, density).k(n)
     peak = _peak_flops_per_chip()
     # cost_analysis reports PER-DEVICE flops for an SPMD-partitioned module
     # (verified empirically on a 4-device mesh), so this is already /chip.
@@ -257,6 +270,16 @@ def measure_breakdown(cfg: BenchConfig, mode: Optional[str],
                       density: float) -> Dict[str, float]:
     """Per-phase seconds (forward+backward / compress / comm / apply), each
     jitted and synced separately — the reference's timer-dict decomposition."""
+    from gtopkssgd_tpu.modes import LAYERWISE_MODES
+
+    if mode in LAYERWISE_MODES:
+        # The whole point of layerwise is that compress has no standalone
+        # flat stage — it fuses into the per-leaf backward epilogues, so a
+        # phase-isolated decomposition would measure a pipeline the mode
+        # never runs. A/B it end-to-end instead (bench.py --compression).
+        raise ValueError(
+            "measure_breakdown assumes the flat compress pipeline; use "
+            "measure_throughput for layerwise modes")
     p = cfg.nworkers or jax.device_count()
     mesh = make_mesh(p)
     model, spec, variables, tx, shape = _setup(cfg, mode, density)

@@ -44,9 +44,11 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--nesterov", action="store_true")
     p.add_argument("--compression", default=None,
                    choices=["none", "dense", "gtopk", "allgather", "topk",
-                            "gtopk_hier"],
+                            "gtopk_hier", "gtopk_layerwise"],
                    help="None/dense = psum baseline; gtopk = tree sparse "
                         "allreduce; allgather/topk = DGC-style union; "
+                        "gtopk_layerwise = per-layer top-k + per-layer "
+                        "error feedback (flat gradient never materializes); "
                         "gtopk_hier = dense within ICI slice, gtopk across "
                         "slices (set --hier-ici)")
     p.add_argument("--density", type=float, default=0.001)

@@ -13,6 +13,16 @@ ALLGATHER_MODES = ("allgather", "topk", "topkA", "topk_allgather")
 # TPU idiom): dense psum within an ICI slice, gTop-k hypercube across
 # slices (the DCN hop, where bandwidth is scarce and sparsity pays).
 HIER_MODES = ("gtopk_hier",)
+# Layer-wise local selection (TPU extension, arXiv:1911.08772 lineage):
+# per-layer top-k_l with k_l = ceil(rho * n_l) instead of one global top-k
+# over the flattened gradient. The LOCAL stage never materializes the
+# [N] flat gradient (the measured serial-tail cost of the flat path on a
+# TPU core — benchmarks/results/fused_variants_TPU_v5_lite.json); the
+# GLOBAL stage is the unchanged gTop-k hypercube over the concatenated
+# per-layer sets, so the communicated set is still a magnitude top-K of
+# the union.
+LAYERWISE_MODES = ("gtopk_layerwise",)
 
-ALL_MODES = DENSE_MODES + GTOPK_MODES + ALLGATHER_MODES + HIER_MODES
-SPARSE_MODES = GTOPK_MODES + ALLGATHER_MODES + HIER_MODES
+ALL_MODES = (DENSE_MODES + GTOPK_MODES + ALLGATHER_MODES + HIER_MODES
+             + LAYERWISE_MODES)
+SPARSE_MODES = GTOPK_MODES + ALLGATHER_MODES + HIER_MODES + LAYERWISE_MODES

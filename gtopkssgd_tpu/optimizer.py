@@ -43,8 +43,18 @@ from jax import lax
 from jax.flatten_util import ravel_pytree
 
 from gtopkssgd_tpu.compression import get_compressor
-from gtopkssgd_tpu.modes import ALL_MODES, DENSE_MODES, HIER_MODES
-from gtopkssgd_tpu.ops import scatter_add_dense
+from gtopkssgd_tpu.modes import (
+    ALL_MODES,
+    DENSE_MODES,
+    HIER_MODES,
+    LAYERWISE_MODES,
+)
+from gtopkssgd_tpu.ops import (
+    k_for_density,
+    membership_mask,
+    scatter_add_dense,
+    select_topk,
+)
 from gtopkssgd_tpu.parallel import ici_dense_psum, sparse_allreduce
 
 Array = jax.Array
@@ -111,6 +121,21 @@ def gtopk_sgd(
     residual passes through the dense phase unchanged (zeros), so error
     feedback starts exactly at the switch.
 
+    ``compression='gtopk_layerwise'`` (TPU extension, arXiv:1911.08772
+    layer-wise-top-k lineage — not reference parity; the reference always
+    flattens, SURVEY.md §3.1) keeps error feedback and selection PER
+    LAYER: residual is a pytree of per-leaf flat buffers, each leaf
+    selects its own top-``ceil(rho * n_leaf)``, and only the concatenated
+    (vals, idx) sets — k elements, not N — ever exist in the flat index
+    space. The flat [N] gradient is never materialized, so each leaf's
+    accumulate/select/zero-out chain can fuse into that leaf's backward
+    epilogue instead of serializing behind a whole-model concatenation
+    (the measured single-chip cost of the flat path —
+    benchmarks/results/fused_variants_TPU_v5_lite.json). The collective
+    is the unchanged gTop-k hypercube over the concatenated set, so the
+    COMMUNICATED set is still a global magnitude top-K of the union;
+    only the local per-device selection is layer-balanced.
+
     ``compression='gtopk_hier'`` enables the two-level TPU-idiom reduction
     (not reference parity — SURVEY.md §5 design option): the raw gradient is
     first dense-psum'd WITHIN each contiguous block of ``hier_ici_size``
@@ -124,6 +149,7 @@ def gtopk_sgd(
     if mode not in ALL_MODES:
         raise ValueError(f"unknown compression mode {mode!r}")
     hier = mode in HIER_MODES
+    layerwise = mode in LAYERWISE_MODES
     if hier_ici_size < 1:
         raise ValueError(f"hier_ici_size must be >= 1, got {hier_ici_size}")
     if hier_ici_size > 1 and not hier:
@@ -172,14 +198,105 @@ def gtopk_sgd(
         return p
 
     def init_fn(params) -> GTopKSGDState:
-        flat, _ = ravel_pytree(params)
+        if layerwise:
+            residual = tuple(
+                jnp.zeros((int(leaf.size),), jnp.float32)
+                for leaf in jax.tree.leaves(params)
+            )
+        else:
+            flat, _ = ravel_pytree(params)
+            residual = compressor.init_residual(flat.shape[0])
         return GTopKSGDState(
             count=jnp.zeros((), jnp.int32),
-            residual=compressor.init_residual(flat.shape[0]),
+            residual=residual,
             inner=inner.init(params),
         )
 
+    def layerwise_update(grads, state: GTopKSGDState, params=None):
+        """Per-layer select/feedback; global reduce on the concatenated set.
+
+        Mirrors the flat update_fn pipeline stage for stage; differs only
+        in WHERE selection and error feedback live (one buffer per layer,
+        never one [N] vector). Leaf order is jax.tree.flatten order of the
+        grads pytree, which init_fn used for the residual, so the two
+        always align."""
+        leaves, treedef = jax.tree.flatten(grads)
+        sizes = [int(leaf.size) for leaf in leaves]
+        ks = [k_for_density(s, density) for s in sizes]
+        offsets, off = [], 0
+        for s in sizes:
+            offsets.append(off)
+            off += s
+        n = off
+        kk_total = sum(ks)
+        flats = [leaf.reshape(-1) for leaf in leaves]
+        if clip_grad_norm is not None:
+            # Same clip-BEFORE-compress order as the flat path; the global
+            # norm is a sum of per-leaf sums — no concatenation needed.
+            gnorm = jnp.sqrt(sum(jnp.sum(f * f) for f in flats))
+            scale = jnp.minimum(1.0, clip_grad_norm / (gnorm + 1e-6))
+            flats = [f * scale for f in flats]
+        p = bound_axis_size()
+
+        def sparse_branch(flats, res_in):
+            accs = [f + r for f, r in zip(flats, res_in)]
+            sel = [select_topk(a, kl, topk_method)
+                   for a, kl in zip(accs, ks)]
+            idx_l = [i for _, i in sel]
+            new_res = [a.at[i].set(0.0, mode="drop")
+                       for a, i in zip(accs, idx_l)]
+            if p == 1:
+                # Same fused identity as the flat path: selected entries
+                # keep their acc value, the rest cancel to 0.0 bit-exactly.
+                return [a - r for a, r in zip(accs, new_res)], tuple(new_res)
+            vals = jnp.concatenate([v for v, _ in sel])
+            idx = jnp.concatenate([
+                (i + o).astype(jnp.int32)
+                for i, o in zip(idx_l, offsets)
+            ])
+            gvals, gidx, _ = sparse_allreduce(
+                mode, vals, idx, k=kk_total, n=n,
+                axis_name=axis_name, axis_size=p,
+            )
+            # Error-feedback repair, split back per leaf: put_back's layout
+            # IS the concatenation order, so static [pos:pos+k_l] slices
+            # address each leaf's candidates.
+            rejected = ~membership_mask(idx, gidx)
+            put_back = jnp.where(rejected, vals, 0.0)
+            repaired, pos = [], 0
+            for r, i, kl in zip(new_res, idx_l, ks):
+                repaired.append(
+                    r.at[i].add(put_back[pos:pos + kl], mode="drop"))
+                pos += kl
+            dense = scatter_add_dense(n, gidx, gvals) / p
+            dense_fl = [dense[o:o + s] for o, s in zip(offsets, sizes)]
+            return dense_fl, tuple(repaired)
+
+        if warmup_dense_steps > 0:
+            def dense_branch(flats, res_in):
+                if p > 1:
+                    flats = [lax.psum(f, axis_name) / p for f in flats]
+                return flats, res_in
+
+            dense_fl, residual = lax.cond(
+                state.count < warmup_dense_steps,
+                dense_branch, sparse_branch, flats, state.residual,
+            )
+        else:
+            dense_fl, residual = sparse_branch(flats, state.residual)
+
+        avg_grads = treedef.unflatten([
+            d.reshape(leaf.shape) for d, leaf in zip(dense_fl, leaves)
+        ])
+        updates, inner_state = inner.update(avg_grads, state.inner, params)
+        new_state = GTopKSGDState(
+            count=state.count + 1, residual=residual, inner=inner_state
+        )
+        return updates, new_state
+
     def update_fn(grads, state: GTopKSGDState, params=None):
+        if layerwise:
+            return layerwise_update(grads, state, params)
         flat, unravel = ravel_pytree(grads)
         n = flat.shape[0]
         if clip_grad_norm is not None:
@@ -261,33 +378,36 @@ def gtopk_sgd(
 
 
 def expand_residual_per_device(opt_state: GTopKSGDState, p: int, mesh):
-    """Lift the freshly-initialized [N] residual to the per-device [P, N]
+    """Lift the freshly-initialized residual to the per-device [P, ...]
     convention used under shard_map (leading dim = 'dp'; strip with
-    residual[0] inside the block, restore with residual[None] on the way
-    out). The residual at init is zeros by construction, so each device's
-    shard is created DIRECTLY in its P('dp') placement
-    (make_array_from_callback) — a host-side broadcast would materialize
-    the dense [P, N] array on one device first (1.6 GB for ResNet-50 x 16
-    workers), and a jitted zeros-with-out_shardings hits a jax sharding-
-    override assertion when the persistent compilation cache is enabled.
-    Shared by the trainer and the benchmark so their measured paths
-    cannot drift.
+    tree-mapped ``r[0]`` inside the block, restore with ``r[None]`` on the
+    way out). Works leaf-wise, so it covers both the flat-[N] residual and
+    the layerwise per-leaf pytree. The residual at init is zeros by
+    construction, so each device's shard is created DIRECTLY in its
+    P('dp') placement (make_array_from_callback) — a host-side broadcast
+    would materialize the dense [P, N] array on one device first (1.6 GB
+    for ResNet-50 x 16 workers), and a jitted zeros-with-out_shardings
+    hits a jax sharding-override assertion when the persistent compilation
+    cache is enabled. Shared by the trainer and the benchmark so their
+    measured paths cannot drift.
     """
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec
 
-    res_shape = (p,) + opt_state.residual.shape
-    res_dtype = opt_state.residual.dtype
     sharding = NamedSharding(mesh, PartitionSpec("dp"))
 
-    def shard_zeros(index):
-        shape = tuple(len(range(*s.indices(dim)))
-                      for s, dim in zip(index, res_shape))
-        return np.zeros(shape, res_dtype)
+    def expand(res):
+        res_shape = (p,) + res.shape
 
-    return opt_state._replace(residual=jax.make_array_from_callback(
-        res_shape, sharding, shard_zeros,
-    ))
+        def shard_zeros(index):
+            shape = tuple(len(range(*s.indices(dim)))
+                          for s, dim in zip(index, res_shape))
+            return np.zeros(shape, res.dtype)
+
+        return jax.make_array_from_callback(res_shape, sharding, shard_zeros)
+
+    return opt_state._replace(
+        residual=jax.tree.map(expand, opt_state.residual))
 
 
 def effective_density(compression: Optional[str], density: float) -> float:

@@ -52,6 +52,7 @@ from gtopkssgd_tpu.modes import (  # noqa: E402  (re-export)
     DENSE_MODES,
     GTOPK_MODES,
     HIER_MODES,
+    LAYERWISE_MODES,
 )
 
 
@@ -294,7 +295,10 @@ def sparse_allreduce(
     This is the one place the return shape differs across modes; the
     distributed optimizer branches on `gidx is None`.
     """
-    if mode in GTOPK_MODES:
+    if mode in GTOPK_MODES or mode in LAYERWISE_MODES:
+        # Layer-wise mode changes only the LOCAL selection (per-layer k_l
+        # instead of one global top-k); the wire protocol is the same
+        # fixed-K (vals, idx) set, so the hypercube runs unchanged.
         gvals, gidx = gtopk_allreduce(
             vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size
         )
@@ -324,7 +328,9 @@ def comm_bytes_per_step(mode: str, n: int, k: int, p: int,
     slice (which rides ICI — fast links, usually not the bottleneck the
     model is meant to expose) plus the sparse O(k log(P/ici)) across
     slices (the DCN hop the hierarchy exists to thin out)."""
-    if mode in GTOPK_MODES:
+    if mode in GTOPK_MODES or mode in LAYERWISE_MODES:
+        # layerwise: same wire protocol, K differs from rho*N only by the
+        # +1-per-tiny-layer rounding of k_l = ceil(rho * n_l).
         if not _is_pow2(p):
             return 8 * k * p
         return 8 * k * max(1, int(math.log2(p)))

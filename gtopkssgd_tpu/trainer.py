@@ -474,14 +474,18 @@ class Trainer:
             # [1, N] inside the block, [N] inside step().
             c = jax.tree.map(lambda a: a[0], carry) if carry != () else ()
             if p > 1:
+                # tree.map covers both the flat-[N] residual and the
+                # layerwise per-leaf tuple.
                 state = state._replace(opt_state=state.opt_state._replace(
-                    residual=state.opt_state.residual[0]))
+                    residual=jax.tree.map(
+                        lambda r: r[0], state.opt_state.residual)))
             s, c2, loss, aux = step(
                 state, c, jax.tree.map(lambda b: b[0], batch)
             )
             if p > 1:
                 s = s._replace(opt_state=s.opt_state._replace(
-                    residual=s.opt_state.residual[None]))
+                    residual=jax.tree.map(
+                        lambda r: r[None], s.opt_state.residual)))
             if carry != ():
                 c2 = jax.tree.map(lambda a: a[None], c2)
             return s, c2, loss, aux
@@ -760,11 +764,12 @@ class Trainer:
 
         template = jax.tree.map(leaf, self.state)
         if self.p > 1:
-            res = self.state.opt_state.residual
+            dp = NamedSharding(self.mesh, P("dp"))
             template = template._replace(opt_state=template.opt_state._replace(
-                residual=jax.ShapeDtypeStruct(
-                    res.shape, res.dtype,
-                    sharding=NamedSharding(self.mesh, P("dp")))))
+                residual=jax.tree.map(
+                    lambda r: jax.ShapeDtypeStruct(
+                        r.shape, r.dtype, sharding=dp),
+                    self.state.opt_state.residual)))
         return template
 
 
