@@ -192,6 +192,34 @@ def test_layerwise_warmup_phase_bit_equals_dense():
         assert same == (i < 2), f"step {i}: warmup phase mismatch"
 
 
+def test_layerwise_never_materializes_flat_gradient():
+    """The mode's design claim, pinned mechanically: the compiled p=1
+    update program contains NO tensor of the flat [N] shape — selection,
+    error feedback, and the update all stay per-leaf — while the flat
+    gtopk program is full of them (ravel/acc/residual/scatter). This is
+    the property that lets XLA fuse each leaf's compress chain into that
+    leaf's backward epilogue instead of serializing behind a whole-model
+    concatenation (the measured p=1 serial tail of the flat path)."""
+    from gtopkssgd_tpu.models import get_model
+
+    model, _ = get_model("resnet20")
+    variables = model.init({"params": jax.random.PRNGKey(0)},
+                           jnp.zeros((1, 32, 32, 3)))
+    params = variables["params"]
+    n = sum(l.size for l in jax.tree.leaves(params))
+    grads = jax.tree.map(jnp.ones_like, params)
+    flat_shape = f"f32[{n}]"
+
+    counts = {}
+    for mode in ("gtopk", "gtopk_layerwise"):
+        tx = gtopk_sgd(0.1, compression=mode, density=0.001, axis_name=None)
+        st = jax.jit(tx.init)(params)
+        hlo = jax.jit(tx.update).lower(grads, st, params).compile().as_text()
+        counts[mode] = hlo.count(flat_shape)
+    assert counts["gtopk"] > 0  # sanity: the flat path does materialize [N]
+    assert counts["gtopk_layerwise"] == 0, counts
+
+
 def test_layerwise_trainer_checkpoint_roundtrip(tmp_path):
     from gtopkssgd_tpu.trainer import TrainConfig, Trainer
 
