@@ -74,6 +74,12 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
         comm_ms = comm_bytes / link_Bps * 1e3
         extra = 0.0
     elif mode == "gtopk":
+        # This row also covers gtopk_layerwise on the wire: the layerwise
+        # K differs from ceil(rho*N) only by the +1-per-tiny-leaf ceil
+        # rounding (<1% for ResNet-50 at rho=1e-3), and its p=1 overhead
+        # is expected LOWER than overhead_ms (no flat serial tail — the
+        # [N] gradient never materializes; A/B on chip via
+        # bench.py --compression gtopk_layerwise).
         rounds = max(1, math.ceil(math.log2(p))) if p > 1 else 0
         comm_ms = rounds * (8 * k) / link_Bps * 1e3
         extra = overhead_ms

@@ -192,6 +192,26 @@ def test_layerwise_warmup_phase_bit_equals_dense():
         assert same == (i < 2), f"step {i}: warmup phase mismatch"
 
 
+def test_layerwise_lstm_clip_before_compress_trains():
+    """PTB/LSTM path under layerwise: per-leaf selection composes with the
+    clip-BEFORE-compress ordering (SURVEY.md §3.4 — the global norm is a
+    sum of per-leaf sums, no concatenation) and the BPTT carry."""
+    from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+    t = Trainer(TrainConfig(
+        dnn="lstm", batch_size=4, nworkers=1, log_interval=5,
+        eval_batches=2, max_epochs=1, compression="gtopk_layerwise",
+        density=0.05,
+    ))
+    stats = t.train(4)
+    assert np.isfinite(stats["loss"])
+    ev = t.test()
+    assert "val_ppl" in ev and ev["val_ppl"] > 1.0
+    # the lstm config resolves to a clip threshold, so the clip branch
+    # genuinely traced
+    assert t.cfg.resolved().clip_grad_norm is not None
+
+
 def test_layerwise_never_materializes_flat_gradient():
     """The mode's design claim, pinned mechanically: the compiled p=1
     update program contains NO tensor of the flat [N] shape — selection,
