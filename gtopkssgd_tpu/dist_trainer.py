@@ -69,6 +69,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--dense-warmup-epochs", type=int, default=0,
                    help="sparse modes: communicate dense for the first N "
                         "epochs before enabling top-k (warm-up training)")
+    p.add_argument("--momentum-correction", action="store_true",
+                   help="sparse modes: DGC momentum correction + factor "
+                        "masking — velocity accumulates locally BEFORE "
+                        "selection (arXiv:1712.01887 s3, TPU extension)")
     p.add_argument("--nworkers", type=int, default=0,
                    help="mesh size (0 = all visible devices)")
     p.add_argument("--data-dir", default=None)
@@ -112,6 +116,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         nsteps_update=args.nsteps_update,
         warmup_epochs=args.warmup_epochs,
         dense_warmup_epochs=args.dense_warmup_epochs,
+        momentum_correction=args.momentum_correction,
         max_epochs=args.max_epochs,
         nworkers=nworkers,
         data_dir=args.data_dir,

@@ -62,9 +62,17 @@ ScalarOrSchedule = Union[float, Callable[[Array], Array]]
 
 
 class GTopKSGDState(NamedTuple):
-    """State pytree of the distributed optimizer. ``residual`` is the flat
-    error-feedback buffer (f32[N]; empty for the dense path) — checkpointing
-    this state therefore preserves error feedback across resume."""
+    """State pytree of the distributed optimizer. ``residual`` holds the
+    per-device local compression state — checkpointing this state therefore
+    preserves error feedback across resume. Its shape depends on the mode:
+    a flat f32[N] error-feedback buffer (empty for the dense path); a tuple
+    of per-leaf buffers for ``gtopk_layerwise``; and with
+    ``momentum_correction`` a dict ``{"v": <buffer>, "u": <velocity>}``
+    where v is the accumulated-velocity residual DGC selects from and u is
+    the local momentum buffer (same flat/per-leaf shape as v). Every
+    consumer (trainer shard_map strip/restore, per-device expansion, the
+    checkpoint template) tree-maps over the field, so all three layouts
+    ride the same plumbing."""
 
     count: Array
     residual: Array
@@ -85,6 +93,7 @@ def gtopk_sgd(
     axis_size: Optional[int] = None,
     hier_ici_size: int = 1,
     warmup_dense_steps: int = 0,
+    momentum_correction: bool = False,
 ) -> optax.GradientTransformation:
     """Build the distributed gTop-k S-SGD gradient transformation.
 
@@ -144,6 +153,25 @@ def gtopk_sgd(
     hypercube runs only ACROSS the ``P / hier_ici_size`` slices (the DCN
     hop, where sparsity pays). Every device of a slice computes identical
     sets, so the per-device residual stays consistent automatically.
+
+    ``momentum_correction`` (TPU extension, DGC arXiv:1712.01887 §3.1-3.2
+    — not reference parity: the reference runs torch momentum-SGD on the
+    sparse GLOBAL update) moves momentum BEFORE compression: each device
+    keeps a local velocity ``u = momentum*u + grad``, the accumulated
+    velocity ``v += u`` is what top-k selects from, transmitted
+    coordinates are zeroed out of BOTH v and u (momentum factor masking),
+    and the inner optimizer applies the reduced update without further
+    momentum. This corrects the staleness that plain post-collective
+    momentum suffers when a coordinate is transmitted only once every
+    ~1/rho steps. Under gTop-k, masking follows the GLOBAL accept set: a
+    locally-picked but globally-rejected coordinate transmitted nothing,
+    so its velocity is restored alongside its residual value. During a
+    ``warmup_dense_steps`` phase the DENSE mean of u is communicated,
+    which is algebraically identical to classic momentum-SGD on the mean
+    gradient (mean is linear in u) — exactly the dense baseline at
+    weight_decay=0; with weight decay the two differ in whether the
+    wd·params term passes through the momentum trace (dense baseline)
+    or is added un-momentum'd after the collective (correction).
     """
     mode = compression
     if mode not in ALL_MODES:
@@ -166,10 +194,27 @@ def gtopk_sgd(
         # the user believes Nesterov is active would be worse.
         raise ValueError("nesterov momentum requires momentum > 0")
     dense_mode = mode in DENSE_MODES
+    correction = momentum_correction
+    if correction:
+        if dense_mode:
+            raise ValueError(
+                "momentum_correction only applies to sparse modes (the "
+                "dense path IS classic momentum-SGD already)")
+        if not momentum:
+            raise ValueError("momentum_correction requires momentum > 0")
+        if nesterov:
+            raise ValueError(
+                "momentum_correction defines its own velocity recursion; "
+                "nesterov is not expressible in it")
     compressor = get_compressor(mode, density=density, method=topk_method)
     inner = optax.chain(
         optax.add_decayed_weights(weight_decay) if weight_decay else optax.identity(),
-        optax.sgd(learning_rate, momentum=momentum or None, nesterov=nesterov),
+        # With momentum correction the velocity lives BEFORE the collective
+        # (in state.residual["u"]); the inner optimizer must not apply
+        # momentum a second time.
+        optax.sgd(learning_rate,
+                  momentum=None if correction else (momentum or None),
+                  nesterov=nesterov),
     )
 
     def bound_axis_size() -> int:
@@ -206,6 +251,11 @@ def gtopk_sgd(
         else:
             flat, _ = ravel_pytree(params)
             residual = compressor.init_residual(flat.shape[0])
+        if correction:
+            # v: the accumulated-velocity buffer selection reads (plays the
+            # error-feedback residual's role); u: the local momentum buffer.
+            residual = {"v": residual,
+                        "u": jax.tree.map(jnp.zeros_like, residual)}
         return GTopKSGDState(
             count=jnp.zeros((), jnp.int32),
             residual=residual,
@@ -238,17 +288,33 @@ def gtopk_sgd(
             flats = [f * scale for f in flats]
         p = bound_axis_size()
 
-        def sparse_branch(flats, res_in):
-            accs = [f + r for f, r in zip(flats, res_in)]
+        if correction:
+            res_in = state.residual["v"]
+            us = tuple(momentum * u + f
+                       for u, f in zip(state.residual["u"], flats))
+            srcs = list(us)
+        else:
+            res_in = state.residual
+            us = ()
+            srcs = flats
+
+        def sparse_branch(srcs, res_in, us):
+            accs = [s + r for s, r in zip(srcs, res_in)]
             sel = [select_topk(a, kl, topk_method)
                    for a, kl in zip(accs, ks)]
             idx_l = [i for _, i in sel]
             new_res = [a.at[i].set(0.0, mode="drop")
                        for a, i in zip(accs, idx_l)]
+            # Momentum factor masking, per leaf, at the LOCAL selection
+            # (see the measured-ablation note on the flat path).
+            u_out = (tuple(u.at[i].set(0.0, mode="drop")
+                           for u, i in zip(us, idx_l))
+                     if correction else us)
             if p == 1:
                 # Same fused identity as the flat path: selected entries
                 # keep their acc value, the rest cancel to 0.0 bit-exactly.
-                return [a - r for a, r in zip(accs, new_res)], tuple(new_res)
+                return ([a - r for a, r in zip(accs, new_res)],
+                        tuple(new_res), u_out)
             vals = jnp.concatenate([v for v, _ in sel])
             idx = jnp.concatenate([
                 (i + o).astype(jnp.int32)
@@ -268,22 +334,28 @@ def gtopk_sgd(
                 repaired.append(
                     r.at[i].add(put_back[pos:pos + kl], mode="drop"))
                 pos += kl
+            # u stays masked at the full LOCAL selection even for
+            # globally-rejected picks — see the measured-ablation note on
+            # the flat path (restoring u alongside the repaired value
+            # double-tracks the same mass and diverges).
             dense = scatter_add_dense(n, gidx, gvals) / p
             dense_fl = [dense[o:o + s] for o, s in zip(offsets, sizes)]
-            return dense_fl, tuple(repaired)
+            return dense_fl, tuple(repaired), u_out
 
         if warmup_dense_steps > 0:
-            def dense_branch(flats, res_in):
+            def dense_branch(srcs, res_in, us):
                 if p > 1:
-                    flats = [lax.psum(f, axis_name) / p for f in flats]
-                return flats, res_in
+                    srcs = [lax.psum(s, axis_name) / p for s in srcs]
+                return srcs, res_in, us
 
-            dense_fl, residual = lax.cond(
+            dense_fl, residual, u_new = lax.cond(
                 state.count < warmup_dense_steps,
-                dense_branch, sparse_branch, flats, state.residual,
+                dense_branch, sparse_branch, srcs, res_in, us,
             )
         else:
-            dense_fl, residual = sparse_branch(flats, state.residual)
+            dense_fl, residual, u_new = sparse_branch(srcs, res_in, us)
+        if correction:
+            residual = {"v": residual, "u": u_new}
 
         avg_grads = treedef.unflatten([
             d.reshape(leaf.shape) for d, leaf in zip(dense_fl, leaves)
@@ -325,9 +397,27 @@ def gtopk_sgd(
             dense = reduced / p
             residual = state.residual
         else:
-            def sparse_branch(flat, residual_in):
-                acc = compressor.accumulate(flat, residual_in)
+            if correction:
+                # DGC velocity recursion on the LOCAL (or slice-summed, in
+                # hier mode) gradient; selection reads v + u below.
+                res_in = state.residual["v"]
+                u = momentum * state.residual["u"] + flat
+                src = u
+            else:
+                res_in = state.residual
+                u = jnp.zeros((0,), flat.dtype)
+                src = flat
+
+            def sparse_branch(src, residual_in, u_in):
+                acc = compressor.accumulate(src, residual_in)
                 vals, idx, residual = compressor.compress(acc)
+                # Momentum factor masking: a DELIVERED coordinate's
+                # velocity restarts (its momentum was consumed); without
+                # this the same mass re-sends for ~1/momentum more steps.
+                # At p=1 and for the allgather union every local pick is
+                # delivered, so masking at the local selection is exact.
+                u_out = (u_in.at[idx].set(0.0, mode="drop")
+                         if correction else u_in)
                 if p == 1:
                     # No collective at p=1, so the dense update is exactly
                     # acc - residual' (selected entries keep their acc
@@ -345,27 +435,43 @@ def gtopk_sgd(
                         residual = compressor.repair(
                             residual, vals, idx, gidx)
                         dense = scatter_add_dense(n, gidx, result) / p
+                        # NOTE (measured design decision): under gTop-k a
+                        # local pick can be globally REJECTED; one could
+                        # argue its velocity should survive (nothing was
+                        # transmitted). Measured ablation says NO: the
+                        # repair above already preserves the rejected
+                        # VALUE in v, so also keeping u double-tracks the
+                        # same mass (v += u while u compounds) and
+                        # persistently-rejected coordinates blow up —
+                        # val_top1 collapses 0.73 -> 0.17 on the 200-step
+                        # A/B (warmup_ab artifact, ablation entry). The
+                        # local mask above is the stable generalization.
                     else:  # allgather union: dense, every pick lands
                         dense = result / p
-                return dense, residual
+                return dense, residual, u_out
 
             if warmup_dense_steps > 0:
-                def dense_branch(flat, residual_in):
-                    reduced = lax.psum(flat, axis_name) if p > 1 else flat
-                    # In hier mode `flat` is already the within-slice SUM
-                    # (ici_dense_psum above), so a full-axis psum counts
-                    # every original gradient hier_ici_size times — divide
-                    # it back out or every warm-up step trains at an
-                    # ici_size-inflated effective LR.
+                def dense_branch(src, residual_in, u_in):
+                    reduced = lax.psum(src, axis_name) if p > 1 else src
+                    # In hier mode the input is already the within-slice
+                    # SUM (ici_dense_psum above), so a full-axis psum
+                    # counts every original gradient hier_ici_size times —
+                    # divide it back out or every warm-up step trains at
+                    # an ici_size-inflated effective LR. With correction
+                    # the mean of u IS classic momentum on the mean
+                    # gradient (mean is linear in u), and u is NOT masked
+                    # (nothing was transmitted sparsely).
                     scale = p * (hier_ici_size if (hier and p > 1) else 1)
-                    return reduced / scale, residual_in
+                    return reduced / scale, residual_in, u_in
 
-                dense, residual = lax.cond(
+                dense, residual, u_new = lax.cond(
                     state.count < warmup_dense_steps,
-                    dense_branch, sparse_branch, flat, state.residual,
+                    dense_branch, sparse_branch, src, res_in, u,
                 )
             else:
-                dense, residual = sparse_branch(flat, state.residual)
+                dense, residual, u_new = sparse_branch(src, res_in, u)
+            if correction:
+                residual = {"v": residual, "u": u_new}
 
         avg_grads = unravel(dense)
         updates, inner_state = inner.update(avg_grads, state.inner, params)

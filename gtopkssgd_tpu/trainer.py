@@ -83,6 +83,10 @@ class TrainConfig:
                                    # first N epochs, then switch to top-k
                                    # (reference C6 warm-up trick / DGC
                                    # warm-up training, arXiv:1712.01887)
+    momentum_correction: bool = False  # sparse modes: DGC momentum
+                                   # correction + factor masking (velocity
+                                   # accumulates BEFORE selection;
+                                   # arXiv:1712.01887 §3, TPU extension)
     max_epochs: int = 140
     nworkers: int = 1
     data_dir: Optional[str] = None
@@ -202,6 +206,7 @@ class Trainer:
             axis_name="dp" if self.p > 1 else None,
             hier_ici_size=cfg.hier_ici,
             warmup_dense_steps=cfg.dense_warmup_epochs * self.steps_per_epoch,
+            momentum_correction=cfg.momentum_correction,
         )
         self.state, self.carry = self._init_state()
         self._train_step = self._build_train_step()
