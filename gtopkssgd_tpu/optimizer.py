@@ -94,6 +94,7 @@ def gtopk_sgd(
     hier_ici_size: int = 1,
     warmup_dense_steps: int = 0,
     momentum_correction: bool = False,
+    _restore_rejected_u: bool = False,
 ) -> optax.GradientTransformation:
     """Build the distributed gTop-k S-SGD gradient transformation.
 
@@ -163,9 +164,16 @@ def gtopk_sgd(
     and the inner optimizer applies the reduced update without further
     momentum. This corrects the staleness that plain post-collective
     momentum suffers when a coordinate is transmitted only once every
-    ~1/rho steps. Under gTop-k, masking follows the GLOBAL accept set: a
-    locally-picked but globally-rejected coordinate transmitted nothing,
-    so its velocity is restored alongside its residual value. During a
+    ~1/rho steps. Under gTop-k, masking follows the LOCAL selection: a
+    locally-picked but globally-rejected coordinate keeps its VALUE in
+    the residual (the error-feedback repair) but its velocity u stays
+    masked. One could argue the velocity should survive too (nothing was
+    transmitted), but the measured ablation says no — restoring u
+    double-tracks the same mass (v += u while u compounds) and
+    persistently-rejected coordinates blow up; see the
+    ``restore_rejected_u_ablation`` entry of
+    benchmarks/results/warmup_ab_cpu_mesh8.json and the NOTE at the
+    repair site below. During a
     ``warmup_dense_steps`` phase the DENSE mean of u is communicated,
     which is algebraically identical to classic momentum-SGD on the mean
     gradient (mean is linear in u) — exactly the dense baseline at
@@ -206,6 +214,26 @@ def gtopk_sgd(
             raise ValueError(
                 "momentum_correction defines its own velocity recursion; "
                 "nesterov is not expressible in it")
+    if _restore_rejected_u and not correction:
+        raise ValueError("_restore_rejected_u is a momentum_correction "
+                         "ablation knob; it needs momentum_correction=True")
+    if correction and layerwise:
+        import warnings
+
+        # Measured, twice: the combination underperforms BOTH parents at
+        # the 200-step A/B (val_top1 0.250 vs 0.734 correction-alone /
+        # 0.281 layerwise-alone), and the round-3 masking ablations show
+        # it is not a masking-semantics bug (restoring rejected-pick
+        # velocities collapses it further, 0.094): per-leaf quota
+        # selection neutralizes the velocity-informed global ranking that
+        # makes correction work. Allowed (long-budget behavior unknown)
+        # but loudly non-default.
+        warnings.warn(
+            "gtopk_layerwise x momentum_correction measured WORSE than "
+            "either alone (benchmarks/results/warmup_ab_cpu_mesh8.json: "
+            "cold val_top1 0.250 vs 0.734/0.281; masking ablations rule "
+            "out a semantics fix) — prefer one or the other",
+            stacklevel=2)
     compressor = get_compressor(mode, density=density, method=topk_method)
     inner = optax.chain(
         optax.add_decayed_weights(weight_decay) if weight_decay else optax.identity(),
@@ -337,7 +365,20 @@ def gtopk_sgd(
             # u stays masked at the full LOCAL selection even for
             # globally-rejected picks — see the measured-ablation note on
             # the flat path (restoring u alongside the repaired value
-            # double-tracks the same mass and diverges).
+            # double-tracks the same mass and diverges). Layerwise raises
+            # the stakes: per-leaf ceil rounding makes tiny leaves pick
+            # (and usually get globally rejected) EVERY step, so local
+            # masking zeroes their velocity every step — the ablation
+            # knob below measures the alternative for exactly this case
+            # (warmup_ab layerwise arms).
+            if correction and _restore_rejected_u:
+                restored, pos = [], 0
+                for u_masked, u_orig, i, kl in zip(u_out, us, idx_l, ks):
+                    restored.append(u_masked.at[i].add(
+                        jnp.where(rejected[pos:pos + kl], u_orig[i], 0.0),
+                        mode="drop"))
+                    pos += kl
+                u_out = tuple(restored)
             dense = scatter_add_dense(n, gidx, gvals) / p
             dense_fl = [dense[o:o + s] for o, s in zip(offsets, sizes)]
             return dense_fl, tuple(repaired), u_out
@@ -443,9 +484,16 @@ def gtopk_sgd(
                         # VALUE in v, so also keeping u double-tracks the
                         # same mass (v += u while u compounds) and
                         # persistently-rejected coordinates blow up —
-                        # val_top1 collapses 0.73 -> 0.17 on the 200-step
-                        # A/B (warmup_ab artifact, ablation entry). The
-                        # local mask above is the stable generalization.
+                        # see restore_rejected_u_ablation in the
+                        # warmup_ab_cpu_mesh8.json artifact. The local
+                        # mask above is the stable generalization; the
+                        # branch below exists ONLY to reproduce that
+                        # ablation arm (_restore_rejected_u=True).
+                        if correction and _restore_rejected_u:
+                            rej = ~membership_mask(idx, gidx)
+                            u_out = u_out.at[idx].add(
+                                jnp.where(rej, u_in[idx], 0.0),
+                                mode="drop")
                     else:  # allgather union: dense, every pick lands
                         dense = result / p
                 return dense, residual, u_out
@@ -516,7 +564,45 @@ def expand_residual_per_device(opt_state: GTopKSGDState, p: int, mesh):
         residual=jax.tree.map(expand, opt_state.residual))
 
 
-def effective_density(compression: Optional[str], density: float) -> float:
-    """Density actually communicated (1.0 for the dense baseline) — used by
-    the benchmark harness's comm-volume model."""
-    return 1.0 if compression in DENSE_MODES else density
+def wire_k(
+    compression: Optional[str],
+    density: float,
+    n: int,
+    leaf_sizes: Optional[tuple] = None,
+) -> int:
+    """Elements actually COMMUNICATED per device per step (n for dense).
+
+    Flat sparse modes send k = ceil(rho*N). LAYERWISE_MODES send the
+    concatenation of per-leaf selections, k_total = sum_l ceil(rho*n_l),
+    which per-leaf ceil rounding can push SEVERALFOLD above ceil(rho*N)
+    at low densities (ResNet-20 at rho=0.001 has dozens of
+    sub-1000-element BN/bias leaves, each forced to k_l >= 1). Layerwise
+    therefore REQUIRES ``leaf_sizes`` (e.g. ``[p.size for p in
+    jax.tree.leaves(params)]``); calling without them raises instead of
+    silently underestimating. Single source of the wire-K definition —
+    the benchmark comm model and effective_density both derive from it."""
+    if compression in DENSE_MODES:
+        return n
+    if compression in LAYERWISE_MODES:
+        if not leaf_sizes:
+            raise ValueError(
+                "wire_k/effective_density for layerwise modes needs "
+                "leaf_sizes: per-leaf ceil rounding makes the communicated "
+                "set sum(ceil(rho*n_l)), not ceil(rho*N)")
+        return sum(k_for_density(int(s), density) for s in leaf_sizes)
+    return k_for_density(n, density)
+
+
+def effective_density(
+    compression: Optional[str],
+    density: float,
+    leaf_sizes: Optional[tuple] = None,
+) -> float:
+    """Density actually communicated (1.0 for the dense baseline) —
+    ``wire_k / N``; see wire_k for the layerwise leaf_sizes requirement."""
+    if compression in DENSE_MODES:
+        return 1.0
+    if compression in LAYERWISE_MODES:
+        n = sum(int(s) for s in leaf_sizes) if leaf_sizes else 0
+        return wire_k(compression, density, n, leaf_sizes) / n
+    return density

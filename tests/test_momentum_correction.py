@@ -3,8 +3,10 @@
 TPU extension (arXiv:1712.01887 §3.1-3.2 — not reference parity: the
 reference runs torch momentum-SGD on the sparse GLOBAL update). Velocity
 ``u = m*u + g`` accumulates locally BEFORE selection, the accumulated
-velocity ``v += u`` is what top-k reads, and transmitted coordinates are
-zeroed from BOTH v and u (momentum factor masking). Pinned here:
+velocity ``v += u`` is what top-k reads, and momentum factor masking
+zeroes u at the LOCAL selection (while the error-feedback repair returns
+a globally-rejected pick's VALUE to v — the measured semantics; see
+test_correction_masks_at_local_selection). Pinned here:
 
   * 3-step numpy oracle of the v/u recursions + masking at p=1;
   * the dense warm-up phase is ALGEBRAICALLY classic momentum-SGD on the
@@ -149,25 +151,22 @@ def test_correction_spmd_converges_replicated():
             np.testing.assert_array_equal(shards[0], s)
 
 
-def test_correction_masks_only_globally_accepted_picks():
-    """Under gTop-k the factor mask must follow the GLOBAL accept set:
-    a locally-picked but globally-rejected coordinate transmitted nothing,
-    so its velocity survives (it went back to the residual with its
-    value). Construction: device d's gradient peaks at coords {2d, 2d+1}
-    with magnitude growing in d, so the global top-2 is {14, 15} (device
-    7's picks) and every other device's picks are rejected."""
+def _mask_semantics_fixture():
+    """8-way construction with a known global accept set: device d's
+    gradient peaks at coords {2d, 2d+1} with magnitude growing in d, so
+    the global top-2 is {14, 15} (device 7's picks) and every other
+    device's picks are globally rejected. Tie-free by construction."""
     n, k_density = 16, 2 / 16
     params = {"w": jnp.zeros((n,))}
     mesh = make_mesh(PDEV)
     g = np.zeros((PDEV, n), np.float32)
     for d in range(PDEV):
-        # strictly tie-free magnitudes: device 7's pair {24, 23} tops
-        # every other coordinate's single contribution
         g[d, 2 * d] = 10.0 + 2 * d
         g[d, 2 * d + 1] = 9.0 + 2 * d
-    tx = gtopk_sgd(0.1, momentum=0.9, compression="gtopk",
-                   density=k_density, axis_name="dp", axis_size=PDEV,
-                   momentum_correction=True)
+    return n, k_density, params, mesh, g
+
+
+def _run_one_masked_step(params, mesh, g, tx):
     state = jax.jit(tx.init)(params)
 
     def step(grads, state):
@@ -178,14 +177,57 @@ def test_correction_masks_only_globally_accepted_picks():
         step, mesh=mesh, in_specs=(P("dp"), P()),
         out_specs=(P("dp"), P("dp")), check_vma=False))(
             jnp.asarray(g), state)
-    v_all, u_all = np.asarray(v_all), np.asarray(u_all)
-    # device 7's picks {14, 15} are the global set: masked there
+    return np.asarray(v_all), np.asarray(u_all)
+
+
+def test_correction_masks_at_local_selection():
+    """Pins the SHIPPED masking semantics (optimizer.py, measured design
+    decision): the momentum factor mask follows the LOCAL selection, not
+    the global accept set. A locally-picked but globally-rejected
+    coordinate keeps its VALUE in the residual v (error-feedback repair)
+    while its velocity u stays masked — restoring u as well double-tracks
+    the same mass and diverges (restore_rejected_u_ablation entry of
+    benchmarks/results/warmup_ab_cpu_mesh8.json)."""
+    n, k_density, params, mesh, g = _mask_semantics_fixture()
+    tx = gtopk_sgd(0.1, momentum=0.9, compression="gtopk",
+                   density=k_density, axis_name="dp", axis_size=PDEV,
+                   momentum_correction=True)
+    v_all, u_all = _run_one_masked_step(params, mesh, g, tx)
+    # device 7's picks {14, 15} ARE the global set: delivered, so both
+    # the velocity and the residual slot are consumed.
     assert u_all[7, 14] == 0.0 and u_all[7, 15] == 0.0
     assert v_all[7, 14] == 0.0 and v_all[7, 15] == 0.0
-    # device 0's picks {0, 1} were globally rejected: velocity survives
-    # together with the repaired residual value (u = m*0 + g = g here)
-    np.testing.assert_allclose(u_all[0, :2], g[0, :2], rtol=1e-6)
+    # device 0's picks {0, 1} were globally REJECTED: the repair returns
+    # their VALUE to v (u = m*0 + g = g on step 1, and v selects from u),
+    # but u is masked at the local selection and stays masked.
     np.testing.assert_allclose(v_all[0, :2], g[0, :2], rtol=1e-6)
+    np.testing.assert_array_equal(u_all[0, :2], np.zeros(2))
+    # un-picked coordinates are untouched everywhere (no stray masking):
+    # device 0 never selected {14, 15} and contributed 0 mass there.
+    assert v_all[0, 14] == 0.0 and u_all[0, 14] == 0.0
+
+
+def test_correction_restore_u_ablation_flag_restores_rejected_velocity():
+    """The _restore_rejected_u ablation knob (used to generate the
+    warmup_ab ablation entry) implements the OTHER semantics — velocity
+    survives for globally-rejected picks — so the A/B between the two is
+    reproducible. Also pins that the knob is correction-only."""
+    n, k_density, params, mesh, g = _mask_semantics_fixture()
+    tx = gtopk_sgd(0.1, momentum=0.9, compression="gtopk",
+                   density=k_density, axis_name="dp", axis_size=PDEV,
+                   momentum_correction=True, _restore_rejected_u=True)
+    v_all, u_all = _run_one_masked_step(params, mesh, g, tx)
+    # globally-accepted picks (device 7) are still fully consumed
+    assert u_all[7, 14] == 0.0 and u_all[7, 15] == 0.0
+    assert v_all[7, 14] == 0.0 and v_all[7, 15] == 0.0
+    # globally-rejected picks (device 0) keep BOTH value and velocity
+    np.testing.assert_allclose(v_all[0, :2], g[0, :2], rtol=1e-6)
+    np.testing.assert_allclose(u_all[0, :2], g[0, :2], rtol=1e-6)
+
+    with pytest.raises(ValueError, match="ablation"):
+        gtopk_sgd(0.1, momentum=0.9, compression="gtopk",
+                  density=k_density, axis_name=None,
+                  _restore_rejected_u=True)
 
 
 def test_correction_rejects_meaningless_combinations():
@@ -223,3 +265,13 @@ def test_correction_trainer_checkpoint_roundtrip(tmp_path):
         np.asarray(t2.state.opt_state.residual["u"]), u)
     t2.train(2)
     assert int(t2.state.step) == 7
+
+
+def test_correction_layerwise_combination_warns():
+    """The layerwise x correction combination is measured worse than
+    either parent and the round-3 masking ablations rule out a semantics
+    fix (warmup_ab artifact: 0.250 combo vs 0.734/0.281 alone; restore-u
+    collapses it to 0.094) — construction warns, citing the artifact."""
+    with pytest.warns(UserWarning, match="warmup_ab"):
+        gtopk_sgd(0.1, momentum=0.9, compression="gtopk_layerwise",
+                  density=0.01, axis_name=None, momentum_correction=True)
