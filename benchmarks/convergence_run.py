@@ -78,18 +78,34 @@ def run_mode(args, mode: str, density: float, max_epochs: int,
     nothing on disk)."""
     from gtopkssgd_tpu.trainer import TrainConfig, Trainer
 
-    density = 1.0 if mode in ("dense", "none") else density
+    # Arm syntax: a compression mode optionally tagged with mitigation
+    # suffixes — "gtopk+warmup" (1 dense-warmup epoch) and/or
+    # "gtopk+corr" (DGC momentum correction) — so the verdict's arm set
+    # {dense, gtopk, gtopk+warmup, layerwise, correction} is expressible
+    # from the CLI without bespoke flags per arm.
+    parts = mode.split("+")
+    base_mode, extra = parts[0], {}
+    for tag in parts[1:]:
+        if tag == "warmup":
+            extra["dense_warmup_epochs"] = 1
+        elif tag == "corr":
+            extra["momentum_correction"] = True
+        else:
+            raise SystemExit(f"unknown arm suffix {tag!r} in {mode!r} "
+                             "(know: warmup, corr)")
+    density = 1.0 if base_mode in ("dense", "none") else density
     cfg = TrainConfig(
         dnn=args.dnn,
         batch_size=args.batch_size,
         nworkers=args.nworkers or jax.device_count(),
-        compression=mode,
+        compression=base_mode,
         density=density,
         seed=args.seed,
         max_epochs=max_epochs,
         log_interval=10_000_000,  # curve sampling happens here, not in logs
         eval_batches=args.eval_batches,
         data_dir=args.data_dir,
+        **extra,
     )
     curve, losses = [], []
     with Trainer(cfg) as trainer:
@@ -154,16 +170,29 @@ def main():
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--data-dir", default="")
     ap.add_argument("--out", default="")
+    ap.add_argument("--platform", default="", choices=["", "cpu8"],
+                    help="cpu8 = force the 8-way virtual CPU mesh "
+                         "in-process (this machine's sitecustomize "
+                         "overrides JAX_PLATFORMS at interpreter start, "
+                         "so an env-var-only 'cpu' silently dials the "
+                         "accelerator tunnel — same workaround as "
+                         "tests/conftest.py)")
     args = ap.parse_args()
+
+    if args.platform == "cpu8":
+        from gtopkssgd_tpu.utils import force_cpu_mesh
+
+        force_cpu_mesh(8)
 
     from gtopkssgd_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()
     epochs = max_epochs_for(args)
+    device_tag = ("cpu_mesh8" if args.platform == "cpu8" else
+                  jax.devices()[0].device_kind.replace(" ", "_"))
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "results",
-        f"convergence_{args.dnn}_"
-        f"{jax.devices()[0].device_kind.replace(' ', '_')}.jsonl",
+        f"convergence_{args.dnn}_{device_tag}.jsonl",
     )
     # Stream to a .partial sibling and rename on success: crash-durability
     # for THIS run's rows without truncating a previous complete artifact

@@ -1,0 +1,206 @@
+"""Host input pipeline vs chip consumption rate (VERDICT round-2 missing #3).
+
+The reference trained ImageNet through torchvision's multi-worker
+DataLoader on local disk (SURVEY.md C8 — "the reference's input path was
+its luxury"); round 2 verified this repo's loaders against real-format
+fixtures but never measured whether the host can FEED the chip. This
+benchmark closes that: it generates a synthetic ImageFolder of real JPEGs
+(PIL-encoded, ImageNet-like 500x375), then measures the production decode
++ augment + prefetch path end to end:
+
+  1. bare decode+augment rate of ImageNetDataset.epoch (images/s),
+  2. the same stream through utils.Prefetcher with a simulated consumer
+     step (the Trainer's actual IO overlap mechanism),
+  3. the synthetic-fallback generator rate (what bench.py/convergence
+     runs actually use),
+
+and compares against the chip's demand (ResNet-50 v5e bs=128: measured
+~18.9 ms/step -> ~6.8k img/s/chip; bs=256 at 0.243 MFU -> ~2k img/s).
+
+This host has ONE CPU core, so the absolute number is the per-core rate;
+a real TPU VM host (e.g. v5e: 112 vCPU per 4 chips) parallelizes decode
+across workers, so the artifact reports both the measured per-core rate
+and the cores needed to match the chip — the honest "fix or document"
+outcome for SURVEY §7 hard-part #5.
+
+Usage:
+  python benchmarks/input_path_bench.py [--images 2000] [--batch 128]
+Writes benchmarks/results/input_path_<host>.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+
+# Chip demand anchors from committed on-chip measurements
+# (benchmarks/results/bench_r2_TPU_v5_lite.json): ResNet-50 bf16.
+CHIP_DEMAND = {
+    "resnet50_v5e_bs128": round(128 / 18.9e-3),   # ~6772 img/s
+    "resnet50_v5e_bs256": round(256 / 124.5e-3),  # ~2056 img/s (dense bs256)
+}
+
+
+def generate_imagefolder(root: str, n_images: int, n_classes: int,
+                         seed: int) -> float:
+    """Write n_images JPEGs in ImageFolder layout; returns encode rate."""
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    t0 = time.perf_counter()
+    for i in range(n_images):
+        cls = i % n_classes
+        cdir = os.path.join(root, "train", f"class{cls:04d}")
+        os.makedirs(cdir, exist_ok=True)
+        # ImageNet-like dimensions and busy content (noise compresses
+        # badly -> realistic decode cost, ~25-60 KB each at q=85)
+        arr = rng.integers(0, 255, (375, 500, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(
+            os.path.join(cdir, f"img{i:06d}.jpg"), quality=85)
+    return n_images / (time.perf_counter() - t0)
+
+
+def measure_decode_rate(root: str, batch: int, seconds: float,
+                        train: bool) -> dict:
+    from gtopkssgd_tpu.data.imagenet import ImageNetDataset
+
+    ds = ImageNetDataset(split="train" if train else "val",
+                         batch_size=batch, data_dir=root, seed=0)
+    assert not ds.synthetic, "generator did not produce a readable folder"
+    n, t0 = 0, time.perf_counter()
+    it = iter(ds)
+    while time.perf_counter() - t0 < seconds:
+        b = next(it)
+        n += len(b["label"])
+    dt = time.perf_counter() - t0
+    return {"images_per_sec": round(n / dt, 1), "images": n,
+            "seconds": round(dt, 2)}
+
+
+def measure_prefetched_rate(root: str, batch: int, seconds: float,
+                            step_ms: float) -> dict:
+    """The Trainer's real overlap: a Prefetcher worker assembles batches
+    while the consumer 'computes' (sleeps step_ms, standing in for the
+    chip). Reported rate is what the consumer actually sustains."""
+    from gtopkssgd_tpu.data.imagenet import ImageNetDataset
+    from gtopkssgd_tpu.utils import Prefetcher
+
+    ds = ImageNetDataset(split="train", batch_size=batch, data_dir=root,
+                         seed=0)
+    it = iter(ds)
+    pf = Prefetcher(lambda: next(it), depth=2)
+    try:
+        n, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            b = next(pf)
+            time.sleep(step_ms / 1e3)
+            n += len(b["label"])
+        dt = time.perf_counter() - t0
+    finally:
+        pf.close()
+    return {"images_per_sec": round(n / dt, 1), "images": n,
+            "seconds": round(dt, 2), "simulated_step_ms": step_ms}
+
+
+def measure_synth_rate(batch: int, seconds: float) -> dict:
+    from gtopkssgd_tpu.data.imagenet import ImageNetDataset
+
+    ds = ImageNetDataset(split="train", batch_size=batch, data_dir=None,
+                         seed=0)
+    assert ds.synthetic
+    n, t0 = 0, time.perf_counter()
+    it = iter(ds)
+    while time.perf_counter() - t0 < seconds:
+        b = next(it)
+        n += len(b["label"])
+    dt = time.perf_counter() - t0
+    return {"images_per_sec": round(n / dt, 1), "images": n}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=2000)
+    ap.add_argument("--classes", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--seconds", type=float, default=20.0)
+    ap.add_argument("--keep-dir", default="",
+                    help="reuse/keep the generated folder here")
+    args = ap.parse_args()
+
+    root = args.keep_dir or tempfile.mkdtemp(prefix="synth_imagenet_")
+    made = not os.path.isdir(os.path.join(root, "train"))
+    try:
+        if made:
+            print(f"[input_path] generating {args.images} JPEGs in {root}",
+                  flush=True)
+            enc_rate = generate_imagefolder(root, args.images, args.classes,
+                                            seed=0)
+        else:
+            enc_rate = None
+        decode_train = measure_decode_rate(root, args.batch, args.seconds,
+                                           train=True)
+        decode_eval = measure_decode_rate(root, args.batch, args.seconds,
+                                          train=False)
+        prefetched = measure_prefetched_rate(root, args.batch, args.seconds,
+                                             step_ms=18.9)
+        synth = measure_synth_rate(args.batch, min(args.seconds, 10.0))
+    finally:
+        if not args.keep_dir:
+            shutil.rmtree(root, ignore_errors=True)
+
+    ncores = os.cpu_count() or 1
+    per_core = decode_train["images_per_sec"] / ncores
+    report = {
+        "what": ("real-JPEG ImageFolder decode+augment+prefetch rate vs "
+                 "chip demand; see module docstring for the 1-core "
+                 "scaling caveat"),
+        "host_cores": ncores,
+        "n_images": args.images,
+        "batch": args.batch,
+        "jpeg_encode_rate_img_s": (round(enc_rate, 1) if enc_rate else None),
+        "decode_augment_train": decode_train,
+        "decode_centercrop_eval": decode_eval,
+        "prefetched_with_18.9ms_consumer": prefetched,
+        "synthetic_generator": synth,
+        "chip_demand_img_s": CHIP_DEMAND,
+        "cores_needed_for_bs128_chip": math.ceil(
+            CHIP_DEMAND["resnet50_v5e_bs128"] / max(per_core, 1e-9)),
+        "cores_needed_for_bs256_chip": math.ceil(
+            CHIP_DEMAND["resnet50_v5e_bs256"] / max(per_core, 1e-9)),
+        "conclusion": None,  # filled below
+    }
+    deficit128 = (decode_train["images_per_sec"]
+                  < CHIP_DEMAND["resnet50_v5e_bs128"])
+    report["conclusion"] = (
+        f"measured {decode_train['images_per_sec']} img/s/core single-core "
+        f"PIL decode+augment ({'BELOW' if deficit128 else 'above'} the "
+        f"~{CHIP_DEMAND['resnet50_v5e_bs128']} img/s one v5e chip demands "
+        f"at bs=128); a real TPU host amortizes this across "
+        f"{report['cores_needed_for_bs128_chip']} cores' worth of decode "
+        f"workers (v5e hosts ship 112 vCPU per 4 chips = 28/chip), and "
+        f"the Prefetcher overlap already hides decode behind the step "
+        f"whenever rate*cores >= demand"
+    )
+    os.makedirs(RESULTS, exist_ok=True)
+    out = os.path.join(RESULTS, "input_path_1core_host.json")
+    with open(out, "w") as fh:
+        json.dump(report, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps({k: report[k] for k in
+                      ("decode_augment_train", "prefetched_with_18.9ms_consumer",
+                       "cores_needed_for_bs128_chip", "conclusion")}))
+
+
+if __name__ == "__main__":
+    main()

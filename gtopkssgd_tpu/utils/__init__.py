@@ -14,6 +14,7 @@ from gtopkssgd_tpu.utils.metrics import MetricsLogger
 from gtopkssgd_tpu.utils.checkpoint import CheckpointManager
 from gtopkssgd_tpu.utils.settings import (
     enable_compilation_cache,
+    force_cpu_mesh,
     get_logger,
     init_backend_with_deadline,
 )
@@ -29,6 +30,7 @@ __all__ = [
     "CheckpointManager",
     "get_logger",
     "enable_compilation_cache",
+    "force_cpu_mesh",
     "init_backend_with_deadline",
     "Prefetcher",
 ]

@@ -18,6 +18,36 @@ DEBUG = bool(int(os.environ.get("GTOPK_DEBUG", "0")))
 PROFILING = bool(int(os.environ.get("GTOPK_PROFILING", "1")))
 
 
+def _default_cache_dir() -> str:
+    """Repo-local (gitignored) compile-cache dir: /tmp is wiped between
+    sessions on this machine, which re-pays every 20-60 s XLA compile;
+    the repo checkout persists."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".jax_cache")
+
+
+def force_cpu_mesh(n: int = 8) -> None:
+    """Force an n-device virtual CPU mesh for this process.
+
+    This machine's sitecustomize registers the tunneled accelerator
+    plugin at interpreter start and overrides ``JAX_PLATFORMS``, so an
+    env-var-only ``JAX_PLATFORMS=cpu`` silently dials the tunnel — and
+    blocks forever when it is down. The config API wins over both, and
+    any inherited device-count flag is REPLACED (the parent may itself
+    have been forced to a different count). Must run before the jax
+    backend initializes; shared by tests/conftest.py and every CPU-mesh
+    benchmark script so the workaround cannot drift."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def enable_compilation_cache(
     path: str | None = None,
 ) -> None:
@@ -29,8 +59,7 @@ def enable_compilation_cache(
 
     if jax.config.jax_compilation_cache_dir:
         return
-    path = path or os.environ.get("GTOPK_JIT_CACHE",
-                                  "/tmp/jax_cache_gtopkssgd")
+    path = path or os.environ.get("GTOPK_JIT_CACHE", _default_cache_dir())
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

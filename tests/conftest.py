@@ -8,24 +8,23 @@ jax initializes (SURVEY.md §4).
 
 import os
 
-# Must happen before jax initializes its backends. The machine's
-# sitecustomize registers the real TPU plugin and overrides the
-# JAX_PLATFORMS env var, so we use the config API (which wins) in addition.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Must happen before jax initializes its backends; the shared helper also
+# defeats the sitecustomize JAX_PLATFORMS override (see its docstring).
+from gtopkssgd_tpu.utils.settings import (  # noqa: E402
+    _default_cache_dir,
+    force_cpu_mesh,
+)
+
+force_cpu_mesh(8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 # Persistent compilation cache: the suite's cost is dominated by XLA:CPU
-# compiles of model train steps; caching them on disk makes repeated runs
-# (and identical HLO across tests) fast.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_gtopkssgd")
+# compiles of model train steps (this host has ONE core); caching them on
+# disk makes repeated runs (and identical HLO across tests) fast. The dir
+# is repo-local (gitignored) because /tmp is wiped between sessions.
+jax.config.update("jax_compilation_cache_dir", _default_cache_dir())
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 

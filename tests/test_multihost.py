@@ -26,7 +26,8 @@ import sys
 sys.path.insert(0, sys.argv[3])  # repo root (script itself lives in tmp)
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_gtopkssgd")
+from gtopkssgd_tpu.utils.settings import _default_cache_dir
+jax.config.update("jax_compilation_cache_dir", _default_cache_dir())
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 coord, pid = sys.argv[1], int(sys.argv[2])
