@@ -66,21 +66,28 @@ except Exception as e:
 import numpy as np
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from gtopkssgd_tpu.parallel import make_mesh, sparse_allreduce
 
 n, k = cfg["n"], cfg["k"]
 reps, warmup = cfg["reps"], cfg["warmup"]
 mesh = make_mesh(2)
+sharding = NamedSharding(mesh, P("dp"))
 
-# Per-device inputs: a replicated-spec program whose inputs each process
-# owns locally. vals/idx model a realistic top-k set (random coords).
+# Global [2, ...] arrays assembled from each process's local [1, ...] row
+# (1 device per process). vals/idx model a realistic top-k set.
 rng = np.random.default_rng(7 + pid)
-dense_in = jnp.asarray(rng.standard_normal((1, n)), jnp.float32)
-vals_in = jnp.asarray(rng.standard_normal((1, k)), jnp.float32)
-idx_in = jnp.asarray(
-    rng.choice(n, size=(1, k), replace=False).astype(np.int32))
+
+
+def dp_global(local):
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
+dense_in = dp_global(rng.standard_normal((1, n)).astype(np.float32))
+vals_in = dp_global(rng.standard_normal((1, k)).astype(np.float32))
+idx_in = dp_global(rng.choice(n, size=(1, k), replace=False)
+                   .astype(np.int32))
 
 
 def dense_fn(x):
@@ -94,9 +101,11 @@ def gtopk_fn(vals, idx):
 
 
 def allgather_fn(vals, idx):
-    gv, gi, _ = sparse_allreduce("allgather", vals[0], idx[0], k=k, n=n,
-                                 axis_name="dp", axis_size=2)
-    return gv[None], gi[None]
+    # allgather returns the DENSE scattered result (every pick lands,
+    # no global index set) — see optimizer.update's needs_repair=False arm.
+    dense, _, _ = sparse_allreduce("allgather", vals[0], idx[0], k=k, n=n,
+                                   axis_name="dp", axis_size=2)
+    return dense[None]
 
 
 def timed(fn, in_specs, out_specs, args):
@@ -116,7 +125,7 @@ res = {
     "gtopk_s": timed(gtopk_fn, (P("dp"), P("dp")), (P("dp"), P("dp")),
                      (vals_in, idx_in)),
     "allgather_s": timed(allgather_fn, (P("dp"), P("dp")),
-                         (P("dp"), P("dp")), (vals_in, idx_in)),
+                         P("dp"), (vals_in, idx_in)),
 }
 if pid == 0:
     print("PROBE-RESULT " + json.dumps(res))
