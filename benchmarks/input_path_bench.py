@@ -61,7 +61,9 @@ def generate_imagefolder(root: str, n_images: int, n_classes: int,
     t0 = time.perf_counter()
     for i in range(n_images):
         cls = i % n_classes
-        cdir = os.path.join(root, "train", f"class{cls:04d}")
+        # ~10% into val/ so the center-crop eval path is measurable too
+        split = "val" if i % 10 == 9 else "train"
+        cdir = os.path.join(root, split, f"class{cls:04d}")
         os.makedirs(cdir, exist_ok=True)
         # ImageNet-like dimensions and busy content (noise compresses
         # badly -> realistic decode cost, ~25-60 KB each at q=85)
