@@ -74,20 +74,26 @@ def generate_imagefolder(root: str, n_images: int, n_classes: int,
 
 
 def measure_decode_rate(root: str, batch: int, seconds: float,
-                        train: bool) -> dict:
+                        train: bool, decode_workers: int = 0) -> dict:
     from gtopkssgd_tpu.data.imagenet import ImageNetDataset
 
     ds = ImageNetDataset(split="train" if train else "val",
-                         batch_size=batch, data_dir=root, seed=0)
+                         batch_size=batch, data_dir=root, seed=0,
+                         decode_workers=decode_workers)
     assert not ds.synthetic, "generator did not produce a readable folder"
-    n, t0 = 0, time.perf_counter()
-    it = iter(ds)
-    while time.perf_counter() - t0 < seconds:
-        b = next(it)
-        n += len(b["label"])
-    dt = time.perf_counter() - t0
+    try:
+        it = iter(ds)
+        if decode_workers:
+            next(it)  # spawn+import cost paid outside the timed window
+        n, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            b = next(it)
+            n += len(b["label"])
+        dt = time.perf_counter() - t0
+    finally:
+        ds.close()
     return {"images_per_sec": round(n / dt, 1), "images": n,
-            "seconds": round(dt, 2)}
+            "seconds": round(dt, 2), "decode_workers": decode_workers}
 
 
 def measure_prefetched_rate(root: str, batch: int, seconds: float,
@@ -115,6 +121,43 @@ def measure_prefetched_rate(root: str, batch: int, seconds: float,
             "seconds": round(dt, 2), "simulated_step_ms": step_ms}
 
 
+def generate_cifar_pickles(root: str, seed: int) -> None:
+    """Full-size real-format CIFAR-10: 5 train pickles x 10k + test_batch,
+    the exact cifar-10-batches-py layout _load_real parses."""
+    import pickle
+
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    out = os.path.join(root, "cifar-10-batches-py")
+    os.makedirs(out, exist_ok=True)
+    for name, n in [(f"data_batch_{i}", 10_000) for i in range(1, 6)] + [
+            ("test_batch", 10_000)]:
+        d = {b"data": rng.integers(0, 256, (n, 3072), dtype=np.uint8),
+             b"labels": rng.integers(0, 10, n).tolist()}
+        with open(os.path.join(out, name), "wb") as fh:
+            pickle.dump(d, fh)
+
+
+def measure_cifar_epoch(root: str, batch: int) -> dict:
+    """ONE FULL EPOCH (50k images) through the real-pickle CIFAR path with
+    production augmentation — the 'beyond fixture scale' evidence for C8:
+    real pickle parse, real pad/crop/flip (C++ when built), full pass."""
+    from gtopkssgd_tpu.data.cifar import CIFAR10Dataset
+
+    ds = CIFAR10Dataset(split="train", batch_size=batch, data_dir=root,
+                        seed=0)
+    assert not ds.synthetic
+    t0 = time.perf_counter()
+    n = sum(len(b["label"]) for b in ds.epoch(0))
+    dt = time.perf_counter() - t0
+    from gtopkssgd_tpu import native
+
+    return {"images": n, "seconds": round(dt, 2),
+            "images_per_sec": round(n / dt, 1),
+            "native_augment": native.available()}
+
+
 def measure_synth_rate(batch: int, seconds: float) -> dict:
     from gtopkssgd_tpu.data.imagenet import ImageNetDataset
 
@@ -136,6 +179,11 @@ def main():
     ap.add_argument("--classes", type=int, default=20)
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--seconds", type=float, default=20.0)
+    ap.add_argument("--decode-workers", type=int, default=2,
+                    help="pool size for the pooled-rate arm (on a 1-core "
+                         "host expect parity at best; the arm exists to "
+                         "measure pool overhead and to scale on real "
+                         "hosts)")
     ap.add_argument("--keep-dir", default="",
                     help="reuse/keep the generated folder here")
     args = ap.parse_args()
@@ -154,9 +202,15 @@ def main():
                                            train=True)
         decode_eval = measure_decode_rate(root, args.batch, args.seconds,
                                           train=False)
+        decode_pooled = measure_decode_rate(
+            root, args.batch, args.seconds, train=True,
+            decode_workers=args.decode_workers)
         prefetched = measure_prefetched_rate(root, args.batch, args.seconds,
                                              step_ms=18.9)
         synth = measure_synth_rate(args.batch, min(args.seconds, 10.0))
+        print("[input_path] generating full-size CIFAR pickles", flush=True)
+        generate_cifar_pickles(root, seed=0)
+        cifar_epoch = measure_cifar_epoch(root, 32)
     finally:
         if not args.keep_dir:
             shutil.rmtree(root, ignore_errors=True)
@@ -173,8 +227,10 @@ def main():
         "jpeg_encode_rate_img_s": (round(enc_rate, 1) if enc_rate else None),
         "decode_augment_train": decode_train,
         "decode_centercrop_eval": decode_eval,
+        "decode_augment_train_pooled": decode_pooled,
         "prefetched_with_18.9ms_consumer": prefetched,
         "synthetic_generator": synth,
+        "cifar_real_pickles_full_epoch": cifar_epoch,
         "chip_demand_img_s": CHIP_DEMAND,
         "cores_needed_for_bs128_chip": math.ceil(
             CHIP_DEMAND["resnet50_v5e_bs128"] / max(per_core, 1e-9)),

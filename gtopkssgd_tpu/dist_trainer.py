@@ -87,6 +87,11 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--prefetch", type=int, default=2,
                    help="host batches assembled ahead by a background "
                         "thread (0 = synchronous assembly)")
+    p.add_argument("--decode-workers", type=int, default=0,
+                   help="ImageNet real-file path: decode worker processes "
+                        "(reference DataLoader num_workers; ~280 img/s per "
+                        "core vs ~6.8k img/s per v5e chip at bs=128 — see "
+                        "benchmarks/results/input_path_1core_host.json)")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint from out-dir")
     p.add_argument("--multihost", action="store_true",
@@ -126,6 +131,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         eval_batches=args.eval_batches,
         log_interval=args.log_interval,
         prefetch=args.prefetch,
+        decode_workers=args.decode_workers,
     )
 
 

@@ -103,6 +103,12 @@ class TrainConfig:
                                    # background thread (0 = synchronous;
                                    # reference C8 parity with DataLoader
                                    # worker overlap)
+    decode_workers: int = 0        # ImageNet real-file path: decode worker
+                                   # processes (reference DataLoader
+                                   # num_workers; one host core decodes
+                                   # ~280 img/s vs the ~6.8k img/s a v5e
+                                   # chip eats at bs=128 — input_path
+                                   # artifact)
 
     # --- per-dataset defaults (the reference hardcoded these in DLTrainer) --
     def resolved(self) -> "TrainConfig":
@@ -188,6 +194,8 @@ class Trainer:
         data_kw = dict(
             batch_size=cfg.batch_size, data_dir=cfg.data_dir, seed=cfg.seed
         )
+        if cfg.dataset == "imagenet" and cfg.decode_workers > 0:
+            data_kw["decode_workers"] = cfg.decode_workers
         self.train_shards = [
             get_dataset(cfg.dataset, split="train", rank=r,
                         nworkers=cfg.nworkers, **data_kw)
@@ -252,12 +260,17 @@ class Trainer:
         )
 
     def close(self) -> None:
-        """Release background resources (the prefetch worker). Safe to
-        call repeatedly; training can continue afterwards only via a new
-        `_set_iters` (restore does this) — eval is unaffected."""
+        """Release background resources (the prefetch worker and any
+        dataset decode pools). Safe to call repeatedly; training can
+        continue afterwards only via a new `_set_iters` (restore does
+        this — dataset pools re-create lazily) — eval is unaffected."""
         if getattr(self, "_prefetch", None) is not None:
             self._prefetch.close()
             self._prefetch = None
+        for ds in (list(getattr(self, "train_shards", []))
+                   + [getattr(self, "val_data", None)]):
+            if ds is not None and hasattr(ds, "close"):
+                ds.close()
 
     def __enter__(self) -> "Trainer":
         return self
