@@ -69,6 +69,40 @@ class TopKCompressor:
         residual = acc.at[idx].set(0.0, mode="drop")
         return vals, idx, residual
 
+    def compress_by_threshold(self, acc: Array) -> Tuple[Array, Array]:
+        """Mask-form selection for paths that need no wire format.
+
+        Returns (keep bool[N], residual f32[N]) with
+        ``keep = |acc| >= tau`` where tau is the k-th largest magnitude
+        (as reported by the configured selection kernel) and
+        ``residual = where(keep, 0, acc)``.
+
+        Semantically this is the same partition as ``compress`` —
+        selected entries leave the residual, everything else stays — but
+        expressed without index sets: no scatter to zero the residual, no
+        gather to read the values. At p=1 (or any point where the
+        selected set is applied locally rather than sent), index sets
+        buy nothing, and the scatter/gather chain they drag in is what
+        blocks XLA from fusing the selection into the surrounding
+        elementwise pipeline (measured: the fused-step gtopk-over-dense
+        overhead was ~3x the isolated compress cost before this path —
+        see benchmarks/results/fused_variants_TPU_v5_lite.json and the
+        p1_threshold entry of the round-3 bench artifact).
+
+        Set-membership caveats vs ``compress``, both convergence-neutral
+        under error feedback (the keep/residual partition stays exact by
+        construction): magnitude ties at tau all pass (count can exceed
+        k), and with the approx kernel tau is the smallest magnitude the
+        kernel FOUND, so elements the kernel missed but whose magnitude
+        still clears tau are selected here even though compress would
+        have dropped them (a strict superset — threshold recall is >=
+        the kernel's)."""
+        n = acc.shape[0]
+        vals, _ = select_topk(acc, self.k(n), self.method)
+        tau = jnp.min(jnp.abs(vals))
+        keep = jnp.abs(acc) >= tau
+        return keep, jnp.where(keep, 0.0, acc)
+
     def repair(
         self,
         residual: Array,

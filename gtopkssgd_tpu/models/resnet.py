@@ -9,8 +9,14 @@ Two families, faithful to the original papers the reference used:
   * ``ResNetImageNet`` — the bottleneck design: 7x7/2 stem + 3x3/2 max pool,
     stages [3,4,6,3] at widths 256/512/1024/2048 for ResNet-50.
 
-TPU notes: NHWC, compute in ``dtype`` (bfloat16 on the MXU), BatchNorm in
-float32. Projection (option-B) shortcuts on shape change.
+TPU notes: NHWC, compute in ``dtype`` (bfloat16 on the MXU). BatchNorm
+emits activations in ``dtype`` too — flax computes the mean/variance
+reductions in float32 regardless (``force_float32_reductions``), so this
+costs no statistic precision, while a float32 BatchNorm output would force
+every inter-conv activation tensor to flow through HBM at twice the bytes.
+Params stay float32 (flax default ``param_dtype``), so gradient/optimizer/
+compressor dtypes are unchanged. Projection (option-B) shortcuts on shape
+change.
 """
 
 from __future__ import annotations
@@ -31,7 +37,7 @@ class BasicBlock(nn.Module):
     def __call__(self, x, *, train: bool = False):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = functools.partial(
-            nn.BatchNorm, use_running_average=not train, dtype=jnp.float32
+            nn.BatchNorm, use_running_average=not train, dtype=self.dtype
         )
         y = conv(self.filters, (3, 3), strides=self.strides, padding=1)(x)
         y = norm()(y)
@@ -53,7 +59,7 @@ class BottleneckBlock(nn.Module):
     def __call__(self, x, *, train: bool = False):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = functools.partial(
-            nn.BatchNorm, use_running_average=not train, dtype=jnp.float32
+            nn.BatchNorm, use_running_average=not train, dtype=self.dtype
         )
         inner = self.filters // 4
         y = conv(inner, (1, 1))(x)
@@ -79,7 +85,7 @@ class ResNetCIFAR(nn.Module):
             raise ValueError("CIFAR ResNet depth must be 6n+2")
         n = (self.depth - 2) // 6
         x = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
-        x = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
         x = nn.relu(x)
         for stage, width in enumerate((16, 32, 64)):
             for block in range(n):
@@ -99,7 +105,7 @@ class ResNetImageNet(nn.Module):
     def __call__(self, x, *, train: bool = False):
         x = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
                     dtype=self.dtype)(x)
-        x = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for stage, blocks in enumerate(self.stage_sizes):

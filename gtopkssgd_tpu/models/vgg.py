@@ -6,8 +6,10 @@ D: 13 conv layers with BatchNorm+ReLU, five 2x2 max-pools down to 1x1x512,
 and a compact classifier head (512 -> 512 -> classes) instead of the
 4096-wide ImageNet head.
 
-TPU notes: NHWC layout, 3x3 convs in ``dtype`` (bfloat16-ready for the MXU),
-BatchNorm statistics kept in float32 regardless of compute dtype.
+TPU notes: NHWC layout, 3x3 convs in ``dtype`` (bfloat16-ready for the MXU).
+BatchNorm emits activations in ``dtype`` so the inter-conv tensors stay
+half-width in HBM; flax still computes the mean/variance reductions in
+float32 (``force_float32_reductions``), so statistic precision is unchanged.
 """
 
 from __future__ import annotations
@@ -41,7 +43,7 @@ class VGG16(nn.Module):
                 x = nn.Conv(v, (3, 3), padding=1, use_bias=False,
                             dtype=self.dtype)(x)
                 x = nn.BatchNorm(use_running_average=not train,
-                                 dtype=jnp.float32)(x)
+                                 dtype=self.dtype)(x)
                 x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))  # (B, 512) after five pools on 32x32
         x = nn.Dense(512, dtype=self.dtype)(x)

@@ -328,6 +328,24 @@ def gtopk_sgd(
 
         def sparse_branch(srcs, res_in, us):
             accs = [s + r for s, r in zip(srcs, res_in)]
+            if p == 1:
+                # Threshold form of the per-leaf selection (see the flat
+                # path's p=1 branch and compress_by_threshold's
+                # docstring): each leaf's top-k_l becomes one small
+                # reduction for tau_l plus elementwise masks — dropping
+                # the per-leaf scatter+gather pairs, which at ~161
+                # leaves were ~2x161 extra kernels on the step. The
+                # per-leaf k = ceil(density * n_l) is exactly
+                # compressor.k(n_l), so the shared helper applies
+                # unchanged leaf by leaf.
+                sel = [compressor.compress_by_threshold(a) for a in accs]
+                keeps = [keep for keep, _ in sel]
+                new_res = [r for _, r in sel]
+                u_out = (tuple(jnp.where(m, 0.0, u)
+                               for u, m in zip(us, keeps))
+                         if correction else us)
+                return ([a - r for a, r in zip(accs, new_res)],
+                        tuple(new_res), u_out)
             sel = [select_topk(a, kl, topk_method)
                    for a, kl in zip(accs, ks)]
             idx_l = [i for _, i in sel]
@@ -338,11 +356,6 @@ def gtopk_sgd(
             u_out = (tuple(u.at[i].set(0.0, mode="drop")
                            for u, i in zip(us, idx_l))
                      if correction else us)
-            if p == 1:
-                # Same fused identity as the flat path: selected entries
-                # keep their acc value, the rest cancel to 0.0 bit-exactly.
-                return ([a - r for a, r in zip(accs, new_res)],
-                        tuple(new_res), u_out)
             vals = jnp.concatenate([v for v, _ in sel])
             idx = jnp.concatenate([
                 (i + o).astype(jnp.int32)
@@ -451,22 +464,35 @@ def gtopk_sgd(
 
             def sparse_branch(src, residual_in, u_in):
                 acc = compressor.accumulate(src, residual_in)
-                vals, idx, residual = compressor.compress(acc)
-                # Momentum factor masking: a DELIVERED coordinate's
-                # velocity restarts (its momentum was consumed); without
-                # this the same mass re-sends for ~1/momentum more steps.
-                # At p=1 and for the allgather union every local pick is
-                # delivered, so masking at the local selection is exact.
-                u_out = (u_in.at[idx].set(0.0, mode="drop")
-                         if correction else u_in)
                 if p == 1:
-                    # No collective at p=1, so the dense update is exactly
-                    # acc - residual' (selected entries keep their acc
-                    # value, everything else cancels to 0.0 bit-exactly) —
-                    # an elementwise op XLA fuses into the surrounding
-                    # chain, instead of materializing a zeros(N) + scatter.
+                    # No collective at p=1, so nothing ever needs the
+                    # (vals, idx) wire format — select by THRESHOLD
+                    # (compress_by_threshold): one top-k reduction for
+                    # tau, then pure elementwise where-masks for the
+                    # residual, the update, and the velocity. The
+                    # index-set form dragged a scatter (zero the
+                    # residual out) + gather (read the values) through
+                    # the flat [N] vector, and that chain is what kept
+                    # XLA from fusing selection into the backward
+                    # epilogue (fused-step overhead was ~3x the isolated
+                    # compress cost — fused_variants artifact; the
+                    # before/after is in the round-3 bench artifact).
+                    # Masking u at the same keep-mask is exact here:
+                    # every local pick is delivered at p=1.
+                    keep, residual = compressor.compress_by_threshold(acc)
                     dense = acc - residual
+                    u_out = (jnp.where(keep, 0.0, u_in)
+                             if correction else u_in)
                 else:
+                    vals, idx, residual = compressor.compress(acc)
+                    # Momentum factor masking: a DELIVERED coordinate's
+                    # velocity restarts (its momentum was consumed);
+                    # without this the same mass re-sends for ~1/momentum
+                    # more steps. For the allgather union every local
+                    # pick is delivered, so masking at the local
+                    # selection is exact.
+                    u_out = (u_in.at[idx].set(0.0, mode="drop")
+                             if correction else u_in)
                     result, gidx, needs_repair = sparse_allreduce(
                         mode, vals, idx, k=compressor.k(n), n=n,
                         axis_name=axis_name, axis_size=p,

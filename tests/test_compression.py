@@ -90,3 +90,49 @@ def test_none_compressor_passthrough(rng):
     np.testing.assert_array_equal(np.asarray(vals), np.asarray(g))
     np.testing.assert_array_equal(np.asarray(idx), np.arange(n))
     assert res.shape == (0,)
+
+
+def test_compress_by_threshold_matches_exact_topk_partition(rng):
+    """With the exact kernel and no ties, the threshold mask IS the top-k
+    set, and (keep, residual) partition acc exactly."""
+    n = 257
+    comp = TopKCompressor(density=0.05, method="exact")
+    acc = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    keep, res = comp.compress_by_threshold(acc)
+    vals, idx, res_idx_form = comp.compress(acc)
+    # Same selected set (random floats: ties have measure zero).
+    mask = np.zeros(n, bool)
+    mask[np.asarray(idx)] = True
+    np.testing.assert_array_equal(np.asarray(keep), mask)
+    # Same residual, bit-for-bit partition: keep*acc + residual == acc.
+    np.testing.assert_array_equal(np.asarray(res), np.asarray(res_idx_form))
+    recon = np.where(np.asarray(keep), np.asarray(acc), 0.0) + np.asarray(res)
+    np.testing.assert_array_equal(recon, np.asarray(acc))
+
+
+def test_compress_by_threshold_ties_all_pass():
+    """Magnitude ties at tau are all selected (count may exceed k), and the
+    partition invariant still holds exactly."""
+    acc = jnp.asarray([3.0, -3.0, 3.0, 1.0, -1.0, 0.5] + [0.0] * 10)
+    comp = TopKCompressor(density=2 / 16, method="exact")  # k = 2
+    keep, res = comp.compress_by_threshold(acc)
+    k = np.asarray(keep)
+    assert k[:3].all() and not k[3:].any()  # all three |3.0| ties pass
+    assert int(k.sum()) == 3 > comp.k(16)
+    np.testing.assert_array_equal(
+        np.where(k, np.asarray(acc), 0.0) + np.asarray(res), np.asarray(acc)
+    )
+
+
+def test_compress_by_threshold_superset_of_kernel_selection(rng):
+    """For ANY selection kernel, the threshold mask contains every index the
+    kernel itself returned (tau = min |kernel vals|), so threshold recall
+    >= kernel recall — the documented approx-kernel guarantee."""
+    n = 4096
+    comp = TopKCompressor(density=0.01, method="blockwise")
+    acc = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    keep, _ = comp.compress_by_threshold(acc)
+    _, idx = __import__("gtopkssgd_tpu.ops", fromlist=["select_topk"]).select_topk(
+        acc, comp.k(n), comp.method
+    )
+    assert np.asarray(keep)[np.asarray(idx)].all()
