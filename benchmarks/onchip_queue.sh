@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Run every on-chip measurement back to back in ONE tunnel window.
+#
+# The axon TPU tunnel wedges unpredictably (died mid-round in rounds 2 AND
+# 3); when it is up, the priority is to drain the whole measurement queue
+# before touching anything else. Each stage is its own Python process (one
+# process holds the device at a time; a crash or wedge in one stage does
+# not take the rest down — later stages will fail fast on the dead
+# backend via init_backend_with_deadline and leave their absence visible).
+#
+# Usage: bash benchmarks/onchip_queue.sh [outdir=/tmp/onchip_queue]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-/tmp/onchip_queue}
+mkdir -p "$OUT"
+log() { echo "[onchip_queue $(date -u +%H:%M:%S)] $*"; }
+
+log "probe"
+python - <<'EOF' || { echo "backend dead; aborting queue"; exit 3; }
+from gtopkssgd_tpu.utils import init_backend_with_deadline
+raise SystemExit(0 if init_backend_with_deadline(120) else 1)
+EOF
+
+log "bench bs=128"
+python bench.py --batch-size 128 > "$OUT/bench_bs128.json" 2> "$OUT/bench_bs128.log"
+log "bench bs=128 rc=$? $(tail -c 200 "$OUT/bench_bs128.json")"
+
+log "bench bs=256"
+python bench.py --batch-size 256 > "$OUT/bench_bs256.json" 2> "$OUT/bench_bs256.log"
+log "bench bs=256 rc=$?"
+
+log "convergence (5 arms)"
+python benchmarks/convergence_run.py --dnn resnet20 --steps 1200 \
+    --modes dense,gtopk,allgather,gtopk_layerwise,gtopk+corr \
+    --density 0.001 > "$OUT/convergence.log" 2>&1
+log "convergence rc=$?"
+
+log "queue done"
