@@ -80,6 +80,11 @@ def main():
     ap.add_argument("--dtype", default="bfloat16",
                     choices=["float32", "bfloat16"])
     ap.add_argument("--topk-method", default="auto")
+    ap.add_argument("--s2d", action="store_true",
+                    help="resnet50: space-to-depth stem (4x4x12 conv on "
+                         "2x2 pixel blocks instead of 7x7x3 — same linear "
+                         "map, MXU-friendly channel width; equivalence "
+                         "pinned in tests/test_models.py)")
     ap.add_argument("--compression", default="auto",
                     help="sparse mode to benchmark against the dense "
                          "baseline (gtopk | gtopk_layerwise | allgather); "
@@ -94,7 +99,7 @@ def main():
     cfg = BenchConfig(
         dnn=args.dnn, batch_size=args.batch_size,
         min_seconds=args.min_seconds, density=args.density,
-        dtype=args.dtype, topk_method=args.topk_method,
+        dtype=args.dtype, topk_method=args.topk_method, s2d=args.s2d,
     )
     if args.compression == "auto":
         candidates = {

@@ -29,6 +29,11 @@ log "bench bs=256"
 python bench.py --batch-size 256 > "$OUT/bench_bs256.json" 2> "$OUT/bench_bs256.log"
 log "bench bs=256 rc=$?"
 
+log "bench bs=256 s2d stem"
+python bench.py --batch-size 256 --s2d --compression gtopk \
+    > "$OUT/bench_bs256_s2d.json" 2> "$OUT/bench_bs256_s2d.log"
+log "bench s2d rc=$?"
+
 log "convergence (5 arms)"
 python benchmarks/convergence_run.py --dnn resnet20 --steps 1200 \
     --modes dense,gtopk,allgather,gtopk_layerwise,gtopk+corr \

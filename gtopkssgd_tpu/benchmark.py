@@ -57,6 +57,7 @@ class BenchConfig:
     topk_method: str = "auto"
     nworkers: int = 0  # 0 = all devices
     hier_ici: int = 1  # gtopk_hier: devices per ICI slice
+    s2d: bool = False  # resnet50: MXU-friendly space-to-depth stem
 
 
 # Peak dense matmul throughput per chip (bf16), for MFU. Keys match
@@ -95,7 +96,14 @@ def _compiled_flops(compiled) -> Optional[float]:
 
 def _setup(cfg: BenchConfig, mode: Optional[str], density: float):
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-    model, spec = get_model(cfg.dnn, dtype=dtype)
+    kwargs = {"dtype": dtype}
+    if cfg.s2d:
+        if cfg.dnn != "resnet50":
+            raise ValueError(
+                f"--s2d is a resnet50 stem transform; --dnn {cfg.dnn} "
+                "does not take it")
+        kwargs["space_to_depth"] = True
+    model, spec = get_model(cfg.dnn, **kwargs)
     rng = jax.random.PRNGKey(0)
     shape = (cfg.batch_size,) + tuple(spec.example_shape)
     variables = model.init(

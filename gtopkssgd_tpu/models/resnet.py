@@ -100,11 +100,32 @@ class ResNetImageNet(nn.Module):
     stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
     num_classes: int = 1000
     dtype: Any = jnp.float32
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
-        x = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
-                    dtype=self.dtype)(x)
+        if self.space_to_depth:
+            # MXU-friendly stem: the 7x7/2 conv on [224,224,3] runs at
+            # C_in=3 against a 128-lane systolic array (>97% of the
+            # input operand is padding). Rearranging 2x2 pixel blocks
+            # into channels ([B,230,230,3] -> [B,115,115,12]) and
+            # convolving 4x4/VALID is the SAME linear map as an 8x8/2
+            # conv whose kernel's last row/col is free (a superset of
+            # the 7x7: pad 3+3 keeps the original pad-3 window
+            # alignment), at 4x the input channel width. The standard
+            # MLPerf-class TPU ResNet-50 transform; exact-equivalence
+            # with the 7x7 stem is pinned in
+            # tests/test_models.py::test_space_to_depth_stem_equivalence.
+            b, h, w, c = x.shape
+            x = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
+            x = x.reshape(b, (h + 6) // 2, 2, (w + 6) // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5)
+            x = x.reshape(b, (h + 6) // 2, (w + 6) // 2, 4 * c)
+            x = nn.Conv(64, (4, 4), padding="VALID", use_bias=False,
+                        dtype=self.dtype)(x)
+        else:
+            x = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
+                        dtype=self.dtype)(x)
         x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

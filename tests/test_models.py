@@ -118,3 +118,74 @@ def test_bfloat16_forward():
         v.dtype == jnp.float32 for v in jax.tree.leaves(variables["params"])
     )
     assert out.dtype == jnp.float32
+
+
+def test_resnet_bf16_forward_tracks_f32():
+    """BatchNorm now emits activations in the compute dtype; flax still
+    reduces the statistics in f32 (force_float32_reductions), so a bf16
+    forward must stay close to the f32 one — this pins the numerics the
+    round-3 BN-dtype change relies on."""
+    import numpy as np
+
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (4, 32, 32, 3), jnp.float32)
+    outs = {}
+    for dt in (jnp.float32, jnp.bfloat16):
+        model, _ = get_model("resnet20", dtype=dt)
+        vars_ = model.init({"params": rng}, x[:1])
+        logits, _ = model.apply(vars_, x, train=True, mutable=["batch_stats"])
+        outs[dt] = np.asarray(logits, np.float32)
+        assert np.isfinite(outs[dt]).all()
+    # bf16 has ~3 decimal digits; logits of an untrained net are O(1).
+    np.testing.assert_allclose(outs[jnp.bfloat16], outs[jnp.float32],
+                               atol=0.15, rtol=0.15)
+
+
+def test_space_to_depth_stem_equivalence():
+    """The s2d stem ([B,115,115,12] conv 4x4/VALID) computes the same
+    linear map as the 7x7/2 pad-3 stem when its kernel is the 7x7 kernel
+    embedded in the zero-padded 8x8 block layout — pinning that the
+    opt-in MXU-friendly stem is the SAME architecture, not a different
+    one."""
+    import numpy as np
+
+    rng = jax.random.PRNGKey(3)
+    x = jax.random.normal(rng, (2, 224, 224, 3), jnp.float32)
+
+    std, _ = get_model("resnet50")
+    s2d, _ = get_model("resnet50", space_to_depth=True)
+    vs = std.init({"params": rng}, x[:1])
+    vd = s2d.init({"params": rng}, x[:1])
+
+    # Embed the 7x7 kernel into 8x8 (zero LAST row/col: the pad-3+3
+    # window covers rows -3..+4 about each even center) and regroup into
+    # the 2x2-block channel layout used by the s2d reshape.
+    w7 = np.asarray(vs["params"]["Conv_0"]["kernel"])        # [7,7,3,64]
+    w8 = np.zeros((8, 8, 3, 64), np.float32)
+    w8[:7, :7] = w7
+    w4 = w8.reshape(4, 2, 4, 2, 3, 64).transpose(0, 2, 1, 3, 4, 5)
+    w4 = w4.reshape(4, 4, 12, 64)
+
+    vd = jax.tree.map(lambda a: a, vd)  # unfreeze-by-copy (plain dicts)
+    vd["params"]["Conv_0"]["kernel"] = jnp.asarray(w4)
+    # Same downstream weights so the full forwards must agree.
+    for name in vs["params"]:
+        if name != "Conv_0":
+            vd["params"][name] = vs["params"][name]
+
+    ys = std.apply(vs, x, train=False)
+    yd = s2d.apply(vd, x, train=False)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(yd),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_space_to_depth_param_count():
+    """s2d trades the 7x7x3 stem (9408) for 4x4x12 (12288): +2880 params,
+    all other shapes unchanged."""
+    std, _ = get_model("resnet50")
+    s2d, _ = get_model("resnet50", space_to_depth=True)
+    x = jnp.zeros((1, 224, 224, 3))
+    rng = jax.random.PRNGKey(0)
+    n_std = sum(a.size for a in jax.tree.leaves(std.init({"params": rng}, x)["params"]))
+    n_s2d = sum(a.size for a in jax.tree.leaves(s2d.init({"params": rng}, x)["params"]))
+    assert n_s2d - n_std == 12288 - 9408 == 2880
