@@ -40,4 +40,10 @@ python benchmarks/convergence_run.py --dnn resnet20 --steps 1200 \
     --density 0.001 > "$OUT/convergence.log" 2>&1
 log "convergence rc=$?"
 
+log "an4 convergence (chip-only: ~70 s/step on the 1-core host CPU mesh)"
+python benchmarks/convergence_run.py --dnn lstman4 --steps 200 --chunk 20 \
+    --batch-size 8 --modes dense,gtopk --density 0.001 \
+    --eval-batches 8 > "$OUT/convergence_an4.log" 2>&1
+log "an4 rc=$?"
+
 log "queue done"
