@@ -46,4 +46,10 @@ python benchmarks/convergence_run.py --dnn lstman4 --steps 200 --chunk 20 \
     --eval-batches 8 > "$OUT/convergence_an4.log" 2>&1
 log "an4 rc=$?"
 
+log "vgg16 convergence (also ~23 s/step on the host CPU mesh)"
+python benchmarks/convergence_run.py --dnn vgg16 --steps 600 --chunk 25 \
+    --batch-size 32 --modes dense,gtopk+corr --density 0.001 \
+    --eval-batches 16 > "$OUT/convergence_vgg16.log" 2>&1
+log "vgg16 rc=$?"
+
 log "queue done"
