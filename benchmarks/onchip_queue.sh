@@ -29,6 +29,10 @@ log "bench bs=256"
 python bench.py --batch-size 256 > "$OUT/bench_bs256.json" 2> "$OUT/bench_bs256.log"
 log "bench bs=256 rc=$?"
 
+log "bench bs=512 (bf16-BN halves activation bytes; a larger batch may now pay)"
+python bench.py --batch-size 512 > "$OUT/bench_bs512.json" 2> "$OUT/bench_bs512.log"
+log "bench bs=512 rc=$?"
+
 log "bench bs=256 s2d stem"
 python bench.py --batch-size 256 --s2d --compression gtopk \
     > "$OUT/bench_bs256_s2d.json" 2> "$OUT/bench_bs256_s2d.log"

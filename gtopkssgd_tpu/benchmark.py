@@ -96,14 +96,7 @@ def _compiled_flops(compiled) -> Optional[float]:
 
 def _setup(cfg: BenchConfig, mode: Optional[str], density: float):
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-    kwargs = {"dtype": dtype}
-    if cfg.s2d:
-        if cfg.dnn != "resnet50":
-            raise ValueError(
-                f"--s2d is a resnet50 stem transform; --dnn {cfg.dnn} "
-                "does not take it")
-        kwargs["space_to_depth"] = True
-    model, spec = get_model(cfg.dnn, **kwargs)
+    model, spec = get_model(cfg.dnn, dtype=dtype, space_to_depth=cfg.s2d)
     rng = jax.random.PRNGKey(0)
     shape = (cfg.batch_size,) + tuple(spec.example_shape)
     variables = model.init(

@@ -80,6 +80,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--s2d", action="store_true",
+                   help="resnet50: space-to-depth stem (4x4x12 conv on 2x2 "
+                        "pixel blocks; same linear map as the 7x7x3, "
+                        "MXU-friendly channel width)")
     p.add_argument("--num-iters", type=int, default=None,
                    help="train a fixed number of steps instead of epochs")
     p.add_argument("--eval-batches", type=int, default=None)
@@ -128,6 +132,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         out_dir=args.out_dir,
         seed=args.seed,
         dtype=args.dtype,
+        space_to_depth=args.s2d,
         eval_batches=args.eval_batches,
         log_interval=args.log_interval,
         prefetch=args.prefetch,

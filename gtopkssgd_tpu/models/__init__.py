@@ -102,13 +102,25 @@ _register(
 
 
 def get_model(dnn: str, **kwargs: Any) -> Tuple[nn.Module, ModelSpec]:
-    """Build a zoo model by its reference ``--dnn`` flag string."""
+    """Build a zoo model by its reference ``--dnn`` flag string.
+
+    ``space_to_depth`` is accepted for every model so each entry point
+    (trainer CLI, benchmark) can forward its flag unconditionally, but it
+    is a resnet50-only stem transform: any other model rejects a truthy
+    value with a clean error here rather than a constructor TypeError
+    deep in flax."""
     try:
         spec = _ZOO[dnn]
     except KeyError:
         raise ValueError(
             f"unknown dnn {dnn!r}; available: {sorted(_ZOO)}"
         ) from None
+    if not kwargs.get("space_to_depth", True):
+        kwargs.pop("space_to_depth")  # falsy = default stem everywhere
+    elif "space_to_depth" in kwargs and dnn != "resnet50":
+        raise ValueError(
+            f"--s2d is a resnet50 stem transform; --dnn {dnn} "
+            "does not take it")
     return spec.build(**kwargs), spec
 
 

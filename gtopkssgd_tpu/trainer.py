@@ -97,6 +97,10 @@ class TrainConfig:
     out_dir: Optional[str] = None
     seed: int = 42
     dtype: str = "float32"         # compute dtype: 'float32' | 'bfloat16'
+    space_to_depth: bool = False   # resnet50: MXU-friendly s2d stem (same
+                                   # linear map as the 7x7/2 conv; see
+                                   # models/resnet.py and the equivalence
+                                   # test)
     eval_batches: Optional[int] = None   # cap eval batches (None = full)
     log_interval: int = 50
     prefetch: int = 2              # host batches assembled ahead by a
@@ -179,7 +183,9 @@ class Trainer:
         self.timer = StepTimer()
 
         self.model, self.spec = get_model(
-            cfg.dnn, dtype=jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+            cfg.dnn,
+            dtype=jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32,
+            space_to_depth=cfg.space_to_depth,
         )
         self.mesh = make_mesh(cfg.nworkers)
         self.p = cfg.nworkers

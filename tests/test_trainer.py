@@ -301,3 +301,15 @@ def test_ptb_eval_stays_sequential():
     assert not t._eval_sharded
     ev = t.test()
     assert ev["val_ppl"] > 1.0
+
+
+def test_s2d_cli_flag_and_guard():
+    """--s2d plumbs to TrainConfig.space_to_depth; a non-resnet50 model
+    rejects it with a clean error instead of a constructor TypeError."""
+    args = build_argparser().parse_args(
+        ["--dnn", "resnet50", "--s2d", "--nworkers", "1"])
+    cfg = config_from_args(args)
+    assert cfg.space_to_depth
+    bad = small_cfg(space_to_depth=True)  # dnn=resnet20
+    with pytest.raises(ValueError, match="resnet50 stem"):
+        Trainer(bad)
