@@ -61,3 +61,51 @@ def test_convergence_runner_arm_suffixes(tmp_path, monkeypatch):
     ])
     with pytest.raises(SystemExit, match="bogus"):
         mod.main()
+
+
+def test_recompute_rebuilds_thresholds_preserving_measured_fields(tmp_path):
+    """--recompute replaces steps_to_* from stored curves (both the
+    absolute family and the dense-drop family) and keeps measured fields
+    and provenance rows byte-identical."""
+    mod = load_benchmark_module("convergence_run")
+    path = tmp_path / "conv.jsonl"
+    rows = []
+    for mode, losses in (("dense", [4.0, 2.0, 1.0, 1.0]),
+                         ("gtopk", [4.0, 3.0, 2.0, 1.0])):
+        rows += [{"mode": mode, "density": 1.0, "step": 10 * (i + 1),
+                  "loss": l, "throughput": 1.0}
+                 for i, l in enumerate(losses)]
+    rows.append({"note": "provenance", "kind": "note"})
+    # final_loss follows the runner's convention: the rolling-3 tail
+    # mean of the curve (mean(2,1,1) = 1.3333 for dense).
+    rows.append({"mode": "dense", "density": 1.0, "final_loss": 1.33333,
+                 "val_top1": 0.9, "steps_to_0.5x_ref": 123,
+                 "kind": "summary"})
+    rows.append({"mode": "gtopk", "density": 0.001, "final_loss": 2.0,
+                 "val_top1": 0.8, "kind": "summary"})
+    rows.append({"dnn": "resnet20", "steps": 40, "batch_size": 4,
+                 "device_kind": "cpu", "nworkers": 1,
+                 "threshold_reference_loss": 0.0, "modes": [],
+                 "kind": "report"})
+    with open(path, "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+
+    report = mod.recompute_report(str(path))
+    dense, gtopk = report["modes"]
+    # Stale absolute key replaced: the rolling-3 mean first clears
+    # 0.5*ref=2.0 at sample 4 (mean(2,1,1)=1.33; sample 3's mean(4,2,1)
+    # = 2.33 misses), so the stale 123 must become 40.
+    assert dense["steps_to_0.5x_ref"] == 40
+    # dense drop = 4.0-1.3333 = 2.6667; the 98% target 1.3867 is first
+    # cleared by dense's rolling mean 1.3333 at step 40.
+    assert dense["steps_to_0.98_of_dense_drop"] == 40
+    assert gtopk["steps_to_0.98_of_dense_drop"] is None or \
+        gtopk["steps_to_0.98_of_dense_drop"] >= 40
+    # Measured fields preserved.
+    assert dense["val_top1"] == 0.9 and gtopk["val_top1"] == 0.8
+    assert gtopk["final_loss_vs_dense"] == 1.5
+    # Provenance row survives the rewrite.
+    kept = [json.loads(l) for l in open(path)]
+    assert any(r.get("kind") == "note" for r in kept)
+    assert any(r.get("kind") == "report" and "recomputed" in r for r in kept)
