@@ -218,6 +218,14 @@ def attach_thresholds(summaries, curves):
     return ref
 
 
+def _write_tail(fh, summaries, report):
+    """Summary + report serialization shared by fresh runs and --recompute
+    so both always emit the same artifact shape."""
+    for s in summaries:
+        fh.write(json.dumps({**s, "kind": "summary"}) + "\n")
+    fh.write(json.dumps({**report, "kind": "report"}) + "\n")
+
+
 def recompute_report(path: str) -> dict:
     """Rebuild the summary/report rows of an existing artifact from its
     own curve rows (e.g. after a threshold-method change), preserving
@@ -238,7 +246,9 @@ def recompute_report(path: str) -> dict:
         elif kind is None and "step" in r and "loss" in r:
             curves[r["mode"]].append(r)
         else:
-            extras.append({**r, "kind": kind})
+            # Pass provenance rows through byte-identically: re-add the
+            # kind tag only if the row actually had one.
+            extras.append({**r, "kind": kind} if kind is not None else r)
     if report is None or not summaries:
         raise SystemExit(f"{path}: no report/summary rows to recompute")
     ref = attach_thresholds(summaries, curves)
@@ -256,9 +266,7 @@ def recompute_report(path: str) -> dict:
                 fh.write(json.dumps(r) + "\n")
         for r in extras:
             fh.write(json.dumps(r) + "\n")
-        for s in summaries:
-            fh.write(json.dumps({**s, "kind": "summary"}) + "\n")
-        fh.write(json.dumps({**report, "kind": "report"}) + "\n")
+        _write_tail(fh, summaries, report)
     os.replace(partial, path)
     return report
 
@@ -334,9 +342,7 @@ def main():
                   "nworkers": args.nworkers or jax.device_count(),
                   "threshold_reference_loss": round(ref, 5),
                   "modes": summaries}
-        for s in summaries:
-            fh.write(json.dumps({**s, "kind": "summary"}) + "\n")
-        fh.write(json.dumps({**report, "kind": "report"}) + "\n")
+        _write_tail(fh, summaries, report)
     os.replace(partial, out)
     print(json.dumps(report))
 

@@ -1,0 +1,56 @@
+"""measure_throughput stays runnable off-chip: the bench.py path compiles
+and measures every mode the on-chip queue invokes, so a tracing/shape
+regression surfaces in CI instead of burning a tunnel window (the tunnel
+has died mid-round two rounds running — any bench.py breakage discovered
+on-chip costs a scarce uptime window to diagnose).
+"""
+
+import pytest
+
+from gtopkssgd_tpu.benchmark import BenchConfig, measure_throughput
+
+
+@pytest.mark.parametrize("mode,density", [
+    ("dense", 1.0),
+    ("gtopk", 0.05),
+    ("gtopk_layerwise", 0.05),
+])
+def test_measure_throughput_runs_every_bench_mode(mode, density):
+    cfg = BenchConfig(dnn="resnet20", batch_size=4, min_seconds=0.05)
+    stats = measure_throughput(cfg, mode, density)
+    assert stats["sec_per_step"] > 0
+    assert stats["images_per_sec_per_chip"] > 0
+    assert stats["steps_timed"] >= 1
+
+
+def test_measure_throughput_s2d_resnet50_traces():
+    """The s2d queue stage must at least trace+lower off-chip; full
+    XLA:CPU compilation of ResNet-50 is minutes on this 1-core host, so
+    stop at lowering — tracing is where a bad reshape/kwarg would die."""
+    import jax
+    import optax
+    from jax import numpy as jnp
+
+    from gtopkssgd_tpu.benchmark import _setup
+
+    cfg = BenchConfig(dnn="resnet50", batch_size=2, s2d=True)
+    model, spec, variables, tx, shape = _setup(cfg, "gtopk", 0.001)
+
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            out, _ = model.apply(
+                {"params": p, "batch_stats": variables["batch_stats"]},
+                x, train=True, mutable=["batch_stats"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, y).mean()
+
+        grads = jax.grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    params = variables["params"]
+    opt0 = tx.init(params)
+    x = jnp.zeros((2, 224, 224, 3))
+    y = jnp.zeros((2,), jnp.int32)
+    lowered = jax.jit(step).lower(params, opt0, x, y)
+    assert "module" in lowered.as_text()[:200]  # produced StableHLO
