@@ -105,6 +105,7 @@ def run_mode(args, mode: str, density: float, max_epochs: int,
         log_interval=10_000_000,  # curve sampling happens here, not in logs
         eval_batches=args.eval_batches,
         data_dir=args.data_dir,
+        dtype=args.dtype,
         **extra,
     )
     curve, losses = [], []
@@ -284,6 +285,11 @@ def main():
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--data-dir", default="")
     ap.add_argument("--out", default="")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="compute dtype for every arm (the bench headline "
+                         "runs bfloat16; a bf16-vs-f32 convergence A/B "
+                         "backs that config's correctness)")
     ap.add_argument("--recompute", default="",
                     help="rebuild an existing artifact's steps_to_* "
                          "columns from its stored curve rows, then exit "
@@ -312,9 +318,12 @@ def main():
     epochs = max_epochs_for(args)
     device_tag = ("cpu_mesh8" if args.platform == "cpu8" else
                   jax.devices()[0].device_kind.replace(" ", "_"))
+    # The dtype is an artifact dimension: a bf16 run must not clobber the
+    # f32 capture of the same dnn/device.
+    dtype_tag = "" if args.dtype == "float32" else "_bf16"
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "results",
-        f"convergence_{args.dnn}_{device_tag}.jsonl",
+        f"convergence_{args.dnn}{dtype_tag}_{device_tag}.jsonl",
     )
     # Stream to a .partial sibling and rename on success: crash-durability
     # for THIS run's rows without truncating a previous complete artifact
@@ -337,7 +346,7 @@ def main():
         ref = attach_thresholds(summaries, curves)
 
         report = {"dnn": args.dnn, "steps": args.steps,
-                  "batch_size": args.batch_size,
+                  "batch_size": args.batch_size, "dtype": args.dtype,
                   "device_kind": jax.devices()[0].device_kind,
                   "nworkers": args.nworkers or jax.device_count(),
                   "threshold_reference_loss": round(ref, 5),
