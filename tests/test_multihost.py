@@ -72,8 +72,19 @@ hcfg = TrainConfig(dnn="resnet20", batch_size=4, nworkers=2,
 with Trainer(hcfg) as th:
     hstats = th.train(1)
     assert np.isfinite(hstats["loss"]), hstats
+
+# Layer-wise mode across the process boundary: the residual is a PER-LEAF
+# pytree each sharded P('dp') — state assembly/donation over real
+# cross-process transport is a different code path from the flat [N]
+# residual the gtopk step above exercised.
+lcfg = TrainConfig(dnn="resnet20", batch_size=4, nworkers=2,
+                   compression="gtopk_layerwise", density=0.01,
+                   max_epochs=1, log_interval=1, eval_batches=1)
+with Trainer(lcfg) as tl:
+    lstats = tl.train(1)
+    assert np.isfinite(lstats["loss"]), lstats
 print(f"MULTIHOST-OK pid={pid} loss={stats['loss']:.4f} "
-      f"hier_loss={hstats['loss']:.4f}")
+      f"hier_loss={hstats['loss']:.4f} lw_loss={lstats['loss']:.4f}")
 """
 
 
