@@ -19,18 +19,19 @@ selection overhead and vs_baseline is expected to be <= 1.0; sparsity
 pays off only when a network is in the path (the multi-chip sweep lives
 in benchmarks/sweep.py).
 
-The measured p=1 ratio (~0.91 at bs=128) is structural, not slack:
-reformulations of the compress chain (masked residual update, recall 0.9,
-bf16 selection) all land within noise of the current code in the FUSED
-step even though they save up to 0.9 ms in isolation — the overhead is a
-bandwidth-bound serial tail (global flat-gradient top-k forces the [N]
-gradient to materialize, blocking the backward-epilogue fusion the dense
-path enjoys; TPU cores run one fused op at a time, so there is nothing to
-overlap it with). Committed evidence:
-benchmarks/results/fused_variants_TPU_v5_lite.json. Larger per-chip batch
-amortizes the fixed tail (ratio 0.98 at bs=256) but also drops the dense
-baseline's own throughput, so the default stays at the batch both modes
-prefer.
+The p=1 ratio measured through round 3 (~0.90 at bs=128 / 0.98 at
+bs=256, bench_r3 artifact) was structural for the INDEX-SET formulation:
+compress-chain reformulations all landed within noise in the fused step
+(fused_variants artifact) because the scatter/gather through the flat
+[N] vector serialized against the backward epilogue. Round 3 replaced
+the p=1 selection with a threshold form (compress_by_threshold: one
+top-k reduction for tau + elementwise masks, no scatter/gather) and made
+BatchNorm emit the compute dtype (halving inter-conv HBM bytes for BOTH
+modes); the before/after of these two changes is queued as the first
+stage of benchmarks/onchip_queue.sh — the tunnel died before they could
+be measured on silicon. Larger per-chip batch amortizes the fixed tail
+but also drops the dense baseline's own throughput, so the default stays
+at the batch both modes prefer.
 
 The measured step is the full production path (forward + backward + error-
 feedback compress + collective + SGD update) in one jitted SPMD program
