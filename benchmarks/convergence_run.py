@@ -244,7 +244,7 @@ def recompute_report(path: str) -> dict:
             summaries.append(r)
         elif kind == "report":
             report = r
-        elif kind is None and "step" in r and "loss" in r:
+        elif kind is None and "step" in r and "loss" in r and "mode" in r:
             curves[r["mode"]].append(r)
         else:
             # Pass provenance rows through byte-identically: re-add the

@@ -96,11 +96,16 @@ class TopKCompressor:
         kernel FOUND, so elements the kernel missed but whose magnitude
         still clears tau are selected here even though compress would
         have dropped them (a strict superset — threshold recall is >=
-        the kernel's)."""
+        the kernel's). When tau == 0 (fewer than k nonzeros in acc, or a
+        kernel padding its value slots with 0.0), zeros are masked OUT of
+        the keep set rather than selected: |x| >= 0 is vacuously true,
+        and "select all" would e.g. zero an entire velocity buffer under
+        momentum correction instead of touching <=k coordinates like the
+        index form does."""
         n = acc.shape[0]
         vals, _ = select_topk(acc, self.k(n), self.method)
         tau = jnp.min(jnp.abs(vals))
-        keep = jnp.abs(acc) >= tau
+        keep = (jnp.abs(acc) >= tau) & (jnp.abs(acc) > 0.0)
         return keep, jnp.where(keep, 0.0, acc)
 
     def repair(

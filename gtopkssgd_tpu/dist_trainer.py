@@ -82,8 +82,9 @@ def build_argparser() -> argparse.ArgumentParser:
                    choices=["float32", "bfloat16"])
     p.add_argument("--s2d", action="store_true",
                    help="resnet50: space-to-depth stem (4x4x12 conv on 2x2 "
-                        "pixel blocks; same linear map as the 7x7x3, "
-                        "MXU-friendly channel width)")
+                        "pixel blocks; a superset of the 7x7x3 map — exact "
+                        "embedding test-pinned — at MXU-friendly channel "
+                        "width)")
     p.add_argument("--num-iters", type=int, default=None,
                    help="train a fixed number of steps instead of epochs")
     p.add_argument("--eval-batches", type=int, default=None)

@@ -117,6 +117,9 @@ class ResNetImageNet(nn.Module):
             # with the 7x7 stem is pinned in
             # tests/test_models.py::test_space_to_depth_stem_equivalence.
             b, h, w, c = x.shape
+            assert h % 2 == 0 and w % 2 == 0, (
+                f"--s2d needs even input H/W (2x2 pixel blocks), got "
+                f"{h}x{w}")
             x = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
             x = x.reshape(b, (h + 6) // 2, 2, (w + 6) // 2, 2, c)
             x = x.transpose(0, 1, 3, 2, 4, 5)

@@ -124,6 +124,23 @@ def test_compress_by_threshold_ties_all_pass():
     )
 
 
+def test_compress_by_threshold_tau_zero_keeps_only_nonzeros():
+    """Degenerate tau == 0 (fewer than k nonzeros): |x| >= 0 is vacuously
+    true, so an unguarded mask would select EVERY coordinate — under
+    momentum correction that zeroes the whole velocity buffer for the
+    leaf. The guard masks zeros out: only the actual nonzeros pass, and
+    the partition invariant still holds exactly. (Round-3 advisor.)"""
+    n = 64
+    comp = TopKCompressor(density=8 / 64, method="exact")  # k = 8
+    acc = jnp.zeros(n).at[3].set(2.0).at[17].set(-1.0)  # 2 nonzeros < k
+    keep, res = comp.compress_by_threshold(acc)
+    k = np.asarray(keep)
+    assert int(k.sum()) == 2 and k[3] and k[17]
+    np.testing.assert_array_equal(
+        np.where(k, np.asarray(acc), 0.0) + np.asarray(res), np.asarray(acc)
+    )
+
+
 def test_compress_by_threshold_superset_of_kernel_selection(rng):
     """For ANY selection kernel, the threshold mask contains every index the
     kernel itself returned (tau = min |kernel vals|), so threshold recall
