@@ -68,6 +68,24 @@ def _fail_fast_if_backend_dead(timeout_s: float = 180.0) -> None:
     raise SystemExit(3)
 
 
+def latest_bench_artifact_path():
+    """Newest committed bench_r*.json in NUMERIC round order (a
+    lexicographic sort would rank bench_r10 before bench_r2 and pin a
+    stale round forever). Shared by the dead-tunnel note below and
+    benchmarks/time_to_quality.py. Returns None if none exist."""
+    import glob
+    import os
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(
+        glob.glob(os.path.join(here, "benchmarks", "results",
+                               "bench_r*.json")),
+        key=lambda p: (int(m.group(1)) if
+                       (m := re.search(r"bench_r(\d+)", p)) else -1, p))
+    return paths[-1] if paths else None
+
+
 def _latest_onchip_artifact_note() -> str:
     """Point a dead-tunnel failure at the round's real on-chip number.
 
@@ -77,21 +95,12 @@ def _latest_onchip_artifact_note() -> str:
     Name it, with its headline line, so BENCH_r0N.json self-documents
     where to look instead of reading as 'no measurement exists'.
     """
-    import glob
     import os
-    import re
 
     here = os.path.dirname(os.path.abspath(__file__))
-    paths = sorted(
-        glob.glob(os.path.join(here, "benchmarks", "results",
-                               "bench_r*.json")),
-        # Numeric round order: a lexicographic sort would rank
-        # bench_r10 before bench_r2 and pin a stale round forever.
-        key=lambda p: (int(m.group(1)) if
-                       (m := re.search(r"bench_r(\d+)", p)) else -1, p))
-    if not paths:
+    path = latest_bench_artifact_path()
+    if path is None:
         return "bench.py: no committed on-chip bench artifact found"
-    path = paths[-1]
     headline = ""
     try:
         with open(path) as f:

@@ -56,22 +56,33 @@ def _ring_allreduce_bytes(n_bytes: int, p: int) -> float:
 
 def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
             overhead_ms: float, ici_gbps: float, dcn_gbps: float,
-            ici_size: int, batch: int) -> dict:
+            ici_size: int, batch: int, dcn_alpha_ms: float = 0.0) -> dict:
     """Projected step time at P devices for one reduction mode.
 
-    Comm cost = bytes / link-bandwidth on the link each phase actually
-    crosses. For flat modes every P is assumed to sit behind the slower
-    of the two links when P exceeds one ICI domain (`ici_size` chips):
-    conservative for ICI-only pods, realistic for multislice.
+    Comm cost = messages x per-message latency + bytes / link-bandwidth
+    on the link each phase actually crosses. For flat modes every P is
+    assumed to sit behind the slower of the two links when P exceeds one
+    ICI domain (`ici_size` chips): conservative for ICI-only pods,
+    realistic for multislice.
+
+    ``dcn_alpha_ms`` is the fitted per-message latency of the slow link
+    (dcn_probe.py's alpha_beta_fit): the gtopk tree pays it once per
+    round regardless of k, dense pays it per ring step, allgather per
+    partner. At alpha=0 (default) this reduces to the round-2
+    bandwidth-only model. ICI latency is kept at 0 — microseconds-class,
+    invisible next to ms-scale DCN terms.
     """
     ici_Bps = ici_gbps * 1e9 / 8
     dcn_Bps = dcn_gbps * 1e9 / 8
     crosses_dcn = p > ici_size
     link_Bps = dcn_Bps if crosses_dcn else ici_Bps
+    alpha_ms = dcn_alpha_ms if crosses_dcn else 0.0
 
     if mode == "dense":
         comm_bytes = _ring_allreduce_bytes(4 * n, p)
-        comm_ms = comm_bytes / link_Bps * 1e3
+        # ring: 2(p-1) sequential message steps
+        comm_ms = (comm_bytes / link_Bps * 1e3
+                   + (2 * (p - 1)) * alpha_ms)
         extra = 0.0
     elif mode == "gtopk":
         # This row also covers gtopk_layerwise on the wire: the layerwise
@@ -81,10 +92,10 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
         # [N] gradient never materializes; A/B on chip via
         # bench.py --compression gtopk_layerwise).
         rounds = max(1, math.ceil(math.log2(p))) if p > 1 else 0
-        comm_ms = rounds * (8 * k) / link_Bps * 1e3
+        comm_ms = rounds * ((8 * k) / link_Bps * 1e3 + alpha_ms)
         extra = overhead_ms
     elif mode == "allgather":
-        comm_ms = (8 * k * p) / link_Bps * 1e3
+        comm_ms = (8 * k * p) / link_Bps * 1e3 + (p - 1) * alpha_ms
         extra = overhead_ms
     elif mode == "gtopk_hier":
         s = min(ici_size, p)
@@ -92,7 +103,7 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
         ici_ms = _ring_allreduce_bytes(4 * n, s) / ici_Bps * 1e3
         rounds = (max(1, math.ceil(math.log2(n_slices)))
                   if n_slices > 1 else 0)
-        dcn_ms = rounds * (8 * k) / dcn_Bps * 1e3
+        dcn_ms = rounds * ((8 * k) / dcn_Bps * 1e3 + dcn_alpha_ms)
         comm_ms = ici_ms + dcn_ms
         extra = overhead_ms
     else:
@@ -128,6 +139,9 @@ def main():
                     help="effective DCN Gbit/s per host")
     ap.add_argument("--ici-size", type=int, default=16,
                     help="chips per ICI domain (slice)")
+    ap.add_argument("--dcn-alpha-ms", type=float, default=0.0,
+                    help="fitted per-message DCN latency (dcn_probe.py "
+                         "alpha_beta_fit.alpha_ms); 0 = bandwidth-only")
     ap.add_argument("--ps", type=int, nargs="+",
                     default=[1, 4, 16, 32, 64, 256])
     args = ap.parse_args()
@@ -136,13 +150,14 @@ def main():
     kw = dict(n=args.n, k=k, compute_ms=args.compute_ms,
               overhead_ms=args.overhead_ms, ici_gbps=args.ici_gbps,
               dcn_gbps=args.dcn_gbps, ici_size=args.ici_size,
-              batch=args.batch)
-    print(json.dumps({"model": "bandwidth-only projection (see docstring)",
+              batch=args.batch, dcn_alpha_ms=args.dcn_alpha_ms)
+    print(json.dumps({"model": ("latency+bandwidth projection (see "
+                                "docstring; alpha=0 => bandwidth-only)"),
                       "k": k, **{a: getattr(args, a)
                                  for a in ("compute_ms", "overhead_ms",
                                            "n", "density", "batch",
                                            "ici_gbps", "dcn_gbps",
-                                           "ici_size")}}))
+                                           "ici_size", "dcn_alpha_ms")}}))
     for p in args.ps:
         if not _is_pow2(p):
             print(f"# skipping P={p}: projection models the pow2 "

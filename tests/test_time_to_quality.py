@@ -1,0 +1,96 @@
+"""time_to_quality.py: the composed time-to-quality projection
+(round-3 verdict missing #5 — BASELINE.md's time-to-76% row in the only
+form one chip permits)."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "benchmarks", "time_to_quality.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("ttq", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_density_filter_excludes_other_rho(tmp_path):
+    """A rho=0.01 arm must not leak into a rho=0.001 composition (it
+    converges far faster and would fake a cheap sparse row)."""
+    ttq = _load()
+    art = tmp_path / "convergence_fake.jsonl"
+    report = {
+        "kind": "report", "steps": 600,
+        "modes": [
+            {"mode": "dense", "density": 1.0,
+             "steps_to_0.9_of_dense_drop": 400},
+            {"mode": "gtopk", "density": 0.01,
+             "steps_to_0.9_of_dense_drop": 100},
+            {"mode": "gtopk+warmup", "density": 0.001,
+             "steps_to_0.9_of_dense_drop": 500},
+        ],
+    }
+    art.write_text(json.dumps(report) + "\n")
+    steps = ttq.steps_to_quality([str(art)], "0.9", 0.001)
+    assert set(steps) == {"dense", "gtopk+warmup"}
+    assert steps["dense"]["steps"] == 400
+    assert steps["gtopk+warmup"]["steps"] == 500
+    # the sparse row carries its own artifact's dense arm for fair ratios
+    assert steps["gtopk+warmup"]["dense_steps"] == 400
+
+
+def test_longest_horizon_wins(tmp_path):
+    ttq = _load()
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    a.write_text(json.dumps({
+        "kind": "report", "steps": 600,
+        "modes": [{"mode": "dense", "density": 1.0,
+                   "steps_to_0.9_of_dense_drop": 400}]}) + "\n")
+    b.write_text(json.dumps({
+        "kind": "report", "steps": 1200,
+        "modes": [{"mode": "dense", "density": 1.0,
+                   "steps_to_0.9_of_dense_drop": 450}]}) + "\n")
+    steps = ttq.steps_to_quality([str(a), str(b)], "0.9", 0.001)
+    assert steps["dense"]["steps"] == 450
+    assert steps["dense"]["src"] == "b.jsonl"
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(
+        REPO, "benchmarks", "results",
+        "convergence_resnet20_warmup1200_cpu_mesh8.jsonl")),
+    reason="committed convergence artifacts required")
+def test_composes_from_committed_artifacts(tmp_path):
+    """End-to-end over the committed artifacts: every row multiplies a
+    measured step count by a projected step time, dense rows are the
+    1.0 reference, and sparse beats dense only where comm dominates."""
+    out = tmp_path / "ttq.json"
+    subprocess.run(
+        [sys.executable, SCRIPT, "--out", str(out), "--ps", "8", "32"],
+        check=True, cwd=REPO, capture_output=True)
+    rep = json.loads(out.read_text())
+    assert rep["factors"]["compute_ms_measured"] > 0
+    table = rep["table"]
+    assert {r["p"] for r in table} == {8, 32}
+    for r in table:
+        # time_to_quality_min is rounded to 2 decimals in the artifact
+        assert r["time_to_quality_min"] == pytest.approx(
+            r["steps_to_quality"] * r["step_ms_projected"] / 1e3 / 60,
+            abs=0.006)
+    dense = {r["p"]: r for r in table if r["mode"] == "dense"}
+    for p, r in dense.items():
+        assert r["vs_dense_time"] == 1.0
+    # At P=32 (crossing DCN in the model) dense pays the O(N) transfer;
+    # any measured sparse mode must beat it on projected step time.
+    sparse32 = [r for r in table if r["p"] == 32 and r["mode"] != "dense"]
+    assert sparse32, "no sparse rows composed"
+    for r in sparse32:
+        assert r["step_ms_projected"] < dense[32]["step_ms_projected"]
