@@ -141,6 +141,11 @@ def main():
                          "of the 7x7 map, exact embedding pinned in "
                          "tests/test_models.py; MXU-friendly channel "
                          "width)")
+    ap.add_argument("--momentum-correction", action="store_true",
+                    help="DGC velocity-before-selection on the sparse "
+                         "arm (the measured best cold-start config; "
+                         "dense baseline arm is unaffected — it is "
+                         "classic momentum already)")
     ap.add_argument("--compression", default="auto",
                     help="sparse mode to benchmark against the dense "
                          "baseline (gtopk | gtopk_layerwise | allgather); "
@@ -156,7 +161,13 @@ def main():
         dnn=args.dnn, batch_size=args.batch_size,
         min_seconds=args.min_seconds, density=args.density,
         dtype=args.dtype, topk_method=args.topk_method, s2d=args.s2d,
+        momentum_correction=args.momentum_correction,
     )
+    if args.compression == "auto" and args.momentum_correction:
+        # layerwise x correction is a measured-worse combination
+        # (warmup_ab ablation; gtopk_sgd warns on it) — a corr bench
+        # compares flat gtopk+corr vs dense only.
+        args.compression = "gtopk"
     if args.compression == "auto":
         candidates = {
             m: measure_throughput(cfg, m, args.density)
@@ -178,8 +189,9 @@ def main():
     def _r(v, nd=4):
         return round(v, nd) if isinstance(v, float) else v
 
+    mode_label = mode + ("+corr" if args.momentum_correction else "")
     print(json.dumps({
-        "metric": f"{args.dnn}_{mode}_rho{args.density}"
+        "metric": f"{args.dnn}_{mode_label}_rho{args.density}"
                   f"_train_throughput_{p}chip",
         "value": round(gtopk["images_per_sec_per_chip"], 2),
         "unit": "images/sec/chip",

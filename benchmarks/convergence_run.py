@@ -90,9 +90,16 @@ def run_mode(args, mode: str, density: float, max_epochs: int,
             extra["dense_warmup_epochs"] = 1
         elif tag == "corr":
             extra["momentum_correction"] = True
+        elif tag in ("exact", "approx", "blockwise", "pallas"):
+            # Selection-kernel A/B arms (round-3 verdict weak #4: no
+            # conv-net had converged through the production approx path;
+            # "gtopk+approx" forces the kernel the >2^20-param auto
+            # route uses, at any model size).
+            extra["topk_method"] = tag
         else:
             raise SystemExit(f"unknown arm suffix {tag!r} in {mode!r} "
-                             "(know: warmup, corr)")
+                             "(know: warmup, corr, exact, approx, "
+                             "blockwise, pallas)")
     density = 1.0 if base_mode in ("dense", "none") else density
     cfg = TrainConfig(
         dnn=args.dnn,

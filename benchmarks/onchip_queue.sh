@@ -38,6 +38,11 @@ python bench.py --batch-size 256 --s2d --compression gtopk \
     > "$OUT/bench_bs256_s2d.json" 2> "$OUT/bench_bs256_s2d.log"
 log "bench s2d rc=$?"
 
+log "bench bs=128 momentum-correction (the recommended-config candidate's step cost)"
+python bench.py --batch-size 128 --momentum-correction \
+    > "$OUT/bench_bs128_corr.json" 2> "$OUT/bench_bs128_corr.log"
+log "bench corr rc=$?"
+
 log "convergence (5 arms)"
 python benchmarks/convergence_run.py --dnn resnet20 --steps 1200 \
     --modes dense,gtopk,allgather,gtopk_layerwise,gtopk+corr \

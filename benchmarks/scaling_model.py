@@ -66,23 +66,34 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
     realistic for multislice.
 
     ``dcn_alpha_ms`` is the fitted per-message latency of the slow link
-    (dcn_probe.py's alpha_beta_fit): the gtopk tree pays it once per
-    round regardless of k, dense pays it per ring step, allgather per
-    partner. At alpha=0 (default) this reduces to the round-2
-    bandwidth-only model. ICI latency is kept at 0 — microseconds-class,
-    invisible next to ms-scale DCN terms.
+    (dcn_probe.py's alpha_beta_fit). At alpha=0 and P inside one slice
+    this reduces to the round-2 bandwidth-only model. ICI latency is
+    kept at 0 — microseconds-class, invisible next to ms-scale DCN
+    terms.
+
+    Topology consistency (round-4 review): when P spans slices, EVERY
+    mode decomposes into an intra-slice phase on ICI plus an inter-slice
+    phase on DCN — charging flat modes DCN latency on intra-slice hops
+    while the hier mode gets slice-aware accounting would rig the
+    comparison. Phase shapes: dense = ring within the slice + ring over
+    the n_slices slice aggregates (a topology-aware dense allreduce, the
+    decomposition XLA itself applies to multislice meshes); gtopk = the
+    hypercube's first log2(s) rounds pair intra-slice partners, the last
+    log2(n_slices) rounds cross DCN; allgather = gather s*k within the
+    slice, then pull the other slices' (p-s)*k over DCN.
     """
     ici_Bps = ici_gbps * 1e9 / 8
     dcn_Bps = dcn_gbps * 1e9 / 8
-    crosses_dcn = p > ici_size
-    link_Bps = dcn_Bps if crosses_dcn else ici_Bps
-    alpha_ms = dcn_alpha_ms if crosses_dcn else 0.0
+    s = min(ici_size, p)
+    n_slices = max(1, p // s)
+    dcn_rounds = (max(1, math.ceil(math.log2(n_slices)))
+                  if n_slices > 1 else 0)
 
     if mode == "dense":
-        comm_bytes = _ring_allreduce_bytes(4 * n, p)
-        # ring: 2(p-1) sequential message steps
-        comm_ms = (comm_bytes / link_Bps * 1e3
-                   + (2 * (p - 1)) * alpha_ms)
+        ici_ms = _ring_allreduce_bytes(4 * n, s) / ici_Bps * 1e3
+        dcn_ms = (_ring_allreduce_bytes(4 * n, n_slices) / dcn_Bps * 1e3
+                  + 2 * (n_slices - 1) * dcn_alpha_ms)
+        comm_ms = ici_ms + dcn_ms
         extra = 0.0
     elif mode == "gtopk":
         # This row also covers gtopk_layerwise on the wire: the layerwise
@@ -91,19 +102,18 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
         # is expected LOWER than overhead_ms (no flat serial tail — the
         # [N] gradient never materializes; A/B on chip via
         # bench.py --compression gtopk_layerwise).
-        rounds = max(1, math.ceil(math.log2(p))) if p > 1 else 0
-        comm_ms = rounds * ((8 * k) / link_Bps * 1e3 + alpha_ms)
+        ici_rounds = max(1, math.ceil(math.log2(s))) if s > 1 else 0
+        comm_ms = (ici_rounds * (8 * k) / ici_Bps * 1e3
+                   + dcn_rounds * ((8 * k) / dcn_Bps * 1e3 + dcn_alpha_ms))
         extra = overhead_ms
     elif mode == "allgather":
-        comm_ms = (8 * k * p) / link_Bps * 1e3 + (p - 1) * alpha_ms
+        comm_ms = ((8 * k * s) / ici_Bps * 1e3
+                   + (8 * k * (p - s)) / dcn_Bps * 1e3
+                   + (n_slices - 1) * dcn_alpha_ms)
         extra = overhead_ms
     elif mode == "gtopk_hier":
-        s = min(ici_size, p)
-        n_slices = max(1, p // s)
         ici_ms = _ring_allreduce_bytes(4 * n, s) / ici_Bps * 1e3
-        rounds = (max(1, math.ceil(math.log2(n_slices)))
-                  if n_slices > 1 else 0)
-        dcn_ms = rounds * ((8 * k) / dcn_Bps * 1e3 + dcn_alpha_ms)
+        dcn_ms = dcn_rounds * ((8 * k) / dcn_Bps * 1e3 + dcn_alpha_ms)
         comm_ms = ici_ms + dcn_ms
         extra = overhead_ms
     else:

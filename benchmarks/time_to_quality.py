@@ -43,16 +43,22 @@ import os
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESULTS = os.path.join(REPO, "benchmarks", "results")
 
-# Convergence-artifact mode label -> the collective actually on the wire.
-WIRE_MODE = {
+# Convergence-artifact base mode -> the collective actually on the wire.
+# Arm suffixes (+warmup, +corr, +exact/approx/... — convergence_run.py's
+# arm syntax) change selection or schedule, never the wire format, so the
+# wire mode is derived from the base mode and every suffix combination is
+# covered automatically.
+BASE_WIRE_MODE = {
     "dense": "dense",
     "gtopk": "gtopk",
-    "gtopk+warmup": "gtopk",
-    "gtopk+corr": "gtopk",
     "gtopk_layerwise": "gtopk",
     "allgather": "allgather",
     "gtopk_hier": "gtopk_hier",
 }
+
+
+def wire_mode(mode: str):
+    return BASE_WIRE_MODE.get(mode.split("+")[0])
 
 
 def _load_scaling_model():
@@ -149,6 +155,13 @@ def main():
     n = block["num_params"]
     batch = block["batch_size_per_chip"]
     k = max(1, math.ceil(args.density * n))
+    # The DGC recursion costs extra per step; when the corr bench block
+    # exists (onchip_queue's bench_bs128_corr stage), +corr rows use its
+    # own measured overhead instead of inheriting plain gtopk's.
+    corr_block = bench.get(f"{args.batch_key}_corr")
+    corr_overhead_ms = (
+        corr_block["gtopk_step_ms"] - corr_block["dense_step_ms"]
+        if corr_block else None)
 
     conv_paths = sorted(glob.glob(
         os.path.join(RESULTS, args.convergence_glob + ".jsonl")))
@@ -183,12 +196,23 @@ def main():
     for p in args.ps:
         dense_proj = sm.project("dense", p, **kw)
         for mode, rec in sorted(steps.items()):
-            wire = WIRE_MODE.get(mode)
+            wire = wire_mode(mode)
             if wire is None:
+                print(f"# dropping mode {mode!r}: unknown base wire mode")
                 continue
-            proj = sm.project(wire, p, **kw)
             # dense pays no selection overhead; sparse modes pay the
-            # measured p=1 overhead (already inside project's `extra`).
+            # measured p=1 overhead (inside project's `extra`); +corr
+            # rows use the corr bench block's own overhead when the
+            # on-chip queue has measured it.
+            if "+corr" in mode and corr_overhead_ms is not None:
+                proj = sm.project(wire, p,
+                                  **{**kw, "overhead_ms": corr_overhead_ms})
+                ov_src = f"{args.batch_key}_corr bench block"
+            else:
+                proj = sm.project(wire, p, **kw)
+                ov_src = (f"{args.batch_key} gtopk block (corr step cost "
+                          "unmeasured on-chip)"
+                          if "+corr" in mode else f"{args.batch_key} block")
             t_min = rec["steps"] * proj["step_ms"] / 1e3 / 60
             # Ratio vs the SAME artifact's dense arm (fair target);
             # falls back to the longest-horizon dense arm if the source
@@ -202,6 +226,7 @@ def main():
                 "steps_to_quality": rec["steps"],
                 "steps_source": rec["src"],
                 "dense_steps_same_artifact": rec["dense_steps"],
+                "overhead_source": ov_src,
                 "step_ms_projected": proj["step_ms"],
                 "comm_ms_projected": proj["comm_ms"],
                 "time_to_quality_min": round(t_min, 2),

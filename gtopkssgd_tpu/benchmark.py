@@ -58,6 +58,7 @@ class BenchConfig:
     nworkers: int = 0  # 0 = all devices
     hier_ici: int = 1  # gtopk_hier: devices per ICI slice
     s2d: bool = False  # resnet50: MXU-friendly space-to-depth stem
+    momentum_correction: bool = False  # DGC velocity-before-selection
 
 
 # Peak dense matmul throughput per chip (bf16), for MFU. Keys match
@@ -106,6 +107,11 @@ def _setup(cfg: BenchConfig, mode: Optional[str], density: float):
         0.1, momentum=0.9, compression=mode, density=density,
         topk_method=cfg.topk_method, axis_name="dp",
         hier_ici_size=cfg.hier_ici if mode in HIER_MODES else 1,
+        # The dense baseline arm of a correction bench reuses this cfg;
+        # dense IS classic momentum already (gtopk_sgd raises on the
+        # combination), so the knob applies to the sparse arm only.
+        momentum_correction=(cfg.momentum_correction
+                             and mode not in DENSE_MODES),
     )
     return model, spec, variables, tx, shape
 

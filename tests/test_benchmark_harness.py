@@ -23,6 +23,18 @@ def test_measure_throughput_runs_every_bench_mode(mode, density):
     assert stats["steps_timed"] >= 1
 
 
+def test_measure_throughput_momentum_correction_both_arms():
+    """The corr queue stage measures BOTH arms from one cfg: the sparse
+    arm gets the DGC recursion, the dense baseline arm must not trip
+    gtopk_sgd's dense x correction ValueError."""
+    cfg = BenchConfig(dnn="resnet20", batch_size=4, min_seconds=0.05,
+                      momentum_correction=True)
+    sparse = measure_throughput(cfg, "gtopk", 0.05)
+    dense = measure_throughput(cfg, "dense", 1.0)
+    assert sparse["images_per_sec_per_chip"] > 0
+    assert dense["images_per_sec_per_chip"] > 0
+
+
 def test_measure_throughput_s2d_resnet50_traces():
     """The s2d queue stage must at least trace+lower off-chip; full
     XLA:CPU compilation of ResNet-50 is minutes on this 1-core host, so

@@ -53,6 +53,19 @@ def test_convergence_runner_arm_suffixes(tmp_path, monkeypatch):
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     assert rows[-1]["modes"][0]["mode"] == "gtopk+corr"
 
+    # selection-kernel arm (weak #4's exact-vs-approx A/B): forces the
+    # approx kernel below the 2^20-param auto threshold and trains
+    out2 = tmp_path / "conv_approx.jsonl"
+    monkeypatch.setattr(sys, "argv", [
+        "convergence_run.py", "--dnn", "resnet20", "--steps", "2",
+        "--chunk", "2", "--batch-size", "4", "--eval-batches", "1",
+        "--nworkers", "2", "--modes", "gtopk+approx",
+        "--out", str(out2),
+    ])
+    mod.main()
+    rows2 = [json.loads(l) for l in out2.read_text().splitlines()]
+    assert rows2[-1]["modes"][0]["mode"] == "gtopk+approx"
+
     import pytest
 
     monkeypatch.setattr(sys, "argv", [

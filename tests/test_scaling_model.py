@@ -63,11 +63,15 @@ def test_allgather_scales_worse_than_gtopk():
 
 def test_comm_complexity_classes():
     m = _load()
-    # gtopk comm grows ~log2(P); allgather ~P; dense ~flat (2(P-1)/P).
+    # Slice-aware model (ici_size=16): the DCN phase dominates at these
+    # link ratios, so gtopk comm grows ~log2(n_slices) and allgather
+    # ~(p - s) — the cross-DCN byte counts, not the single-link
+    # whole-collective counts of the pre-round-4 model.
     g64 = m.project("gtopk", 64, **KW)["comm_ms"]
     g256 = m.project("gtopk", 256, **KW)["comm_ms"]
-    assert math.isclose(g256 / g64, math.log2(256) / math.log2(64),
-                        rel_tol=0.01)
+    assert math.isclose(
+        g256 / g64, math.log2(256 // 16) / math.log2(64 // 16),
+        rel_tol=0.05)
     a64 = m.project("allgather", 64, **KW)["comm_ms"]
     a256 = m.project("allgather", 256, **KW)["comm_ms"]
-    assert math.isclose(a256 / a64, 4.0, rel_tol=0.01)
+    assert math.isclose(a256 / a64, (256 - 16) / (64 - 16), rel_tol=0.05)
