@@ -13,6 +13,11 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=${1:-/tmp/onchip_queue}
 mkdir -p "$OUT"
+# Clear stage outputs from any previous (possibly wedged) drain: stages
+# run front-to-back, so a fresh drain re-measures everything anyway, and
+# leftovers must not be mistaken for this drain's results by the
+# assemble stage (it also applies its own staleness filter).
+rm -f "$OUT"/bench_bs*.json
 log() { echo "[onchip_queue $(date -u +%H:%M:%S)] $*"; }
 
 log "probe"
@@ -42,6 +47,12 @@ log "bench bs=128 momentum-correction (the recommended-config candidate's step c
 python bench.py --batch-size 128 --momentum-correction \
     > "$OUT/bench_bs128_corr.json" 2> "$OUT/bench_bs128_corr.log"
 log "bench corr rc=$?"
+
+log "assemble committed bench artifact from whatever stages succeeded"
+# Round number is derived from the newest committed bench_r<N> artifact
+# (same round on re-assembly from this dir, else N+1) — see derive_round.
+python benchmarks/assemble_bench_artifact.py --queue-dir "$OUT"
+log "assemble rc=$?"
 
 log "convergence (5 arms)"
 python benchmarks/convergence_run.py --dnn resnet20 --steps 1200 \

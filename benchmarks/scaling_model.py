@@ -85,7 +85,10 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
     ici_Bps = ici_gbps * 1e9 / 8
     dcn_Bps = dcn_gbps * 1e9 / 8
     s = min(ici_size, p)
-    n_slices = max(1, p // s)
+    # ceil, not floor: p=24 with 16-chip slices IS a 2-slice job that
+    # crosses DCN (a floor would model it as one all-ICI slice and
+    # charge zero DCN cost — silently optimistic for every ragged P).
+    n_slices = max(1, math.ceil(p / s))
     dcn_rounds = (max(1, math.ceil(math.log2(n_slices)))
                   if n_slices > 1 else 0)
 

@@ -1,0 +1,117 @@
+"""assemble_bench_artifact.py: queue outputs -> committed artifact."""
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "benchmarks", "assemble_bench_artifact.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("asm", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_load_stage_tolerates_warning_lines_and_garbage(tmp_path):
+    asm = _load()
+    good = tmp_path / "good.json"
+    good.write_text("WARNING: axon is experimental\n"
+                    '{"value": 1927.4, "device_kind": "TPU v5 lite"}\n')
+    assert asm.load_stage(str(good))["value"] == 1927.4
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    assert asm.load_stage(str(empty)) is None
+    assert asm.load_stage(str(tmp_path / "absent.json")) is None
+
+
+def test_assembles_partial_drain(tmp_path, monkeypatch):
+    """A drain where only two stages survived still yields an artifact,
+    with the dead stages named in `what`."""
+    asm = _load()
+    qd = tmp_path / "queue"
+    qd.mkdir()
+    block = {"metric": "m", "value": 1900.0, "unit": "images/sec/chip",
+             "vs_baseline": 0.95, "device_kind": "TPU v5 lite"}
+    (qd / "bench_bs128.json").write_text(json.dumps(block) + "\n")
+    (qd / "bench_bs128_corr.json").write_text(
+        json.dumps({**block, "value": 1850.0}) + "\n")
+    monkeypatch.setattr(asm, "RESULTS", str(tmp_path / "results"))
+    monkeypatch.setattr(sys, "argv", [
+        "assemble", "--round", "99", "--queue-dir", str(qd)])
+    asm.main()
+    out = tmp_path / "results" / "bench_r99_TPU_v5_lite.json"
+    art = json.loads(out.read_text())
+    assert art["bs128"]["value"] == 1900.0
+    assert art["bs128_corr"]["value"] == 1850.0
+    assert "bench_bs256.json" in art["what"]  # missing stage is named
+    # a later pass adds the reading without losing blocks
+    monkeypatch.setattr(sys, "argv", [
+        "assemble", "--round", "99", "--queue-dir", str(qd),
+        "--reading", "numbers inspected"])
+    asm.main()
+    art2 = json.loads(out.read_text())
+    assert art2["reading"] == "numbers inspected"
+    assert art2["bs128"]["value"] == 1900.0
+
+
+def test_empty_queue_dir_fails_loud(tmp_path, monkeypatch):
+    asm = _load()
+    monkeypatch.setattr(sys, "argv", [
+        "assemble", "--round", "99", "--queue-dir", str(tmp_path)])
+    import pytest
+
+    with pytest.raises(SystemExit, match="no parseable bench stage"):
+        asm.main()
+
+
+def test_stale_stages_from_previous_drain_excluded(tmp_path, monkeypatch):
+    """A wedged drain leaves old stage files behind; anything much older
+    than the newest stage is a leftover from a previous drain and must
+    not be folded into this round's artifact."""
+    asm = _load()
+    qd = tmp_path / "queue"
+    qd.mkdir()
+    block = {"metric": "m", "value": 2000.0, "unit": "images/sec/chip",
+             "vs_baseline": 1.0, "device_kind": "TPU v5 lite"}
+    fresh = qd / "bench_bs128.json"
+    old = qd / "bench_bs512.json"
+    fresh.write_text(json.dumps(block) + "\n")
+    old.write_text(json.dumps({**block, "value": 1.0}) + "\n")
+    past = time.time() - 10 * 3600
+    os.utime(old, (past, past))
+    monkeypatch.setattr(asm, "RESULTS", str(tmp_path / "results"))
+    monkeypatch.setattr(sys, "argv", [
+        "assemble", "--round", "99", "--queue-dir", str(qd)])
+    asm.main()
+    art = json.loads(
+        (tmp_path / "results" / "bench_r99_TPU_v5_lite.json").read_text())
+    assert "bs128" in art and "bs512" not in art
+    assert "bench_bs512.json" in art["what"]  # named as stale
+
+
+def test_round_derivation(tmp_path, monkeypatch):
+    """--round omitted: N+1 past the newest committed artifact, but the
+    SAME round when that artifact was assembled from this queue dir
+    (re-assembly after --reading or a resumed drain)."""
+    asm = _load()
+    qd = tmp_path / "queue"
+    qd.mkdir()
+    results = tmp_path / "results"
+    results.mkdir()
+    (results / "bench_r3_TPU_v5_lite.json").write_text(json.dumps(
+        {"what": "hand-written round 3", "provenance": "manual"}) + "\n")
+    monkeypatch.setattr(asm, "RESULTS", str(results))
+    assert asm.derive_round(str(qd)) == 4
+    block = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+             "device_kind": "TPU v5 lite"}
+    (qd / "bench_bs128.json").write_text(json.dumps(block) + "\n")
+    monkeypatch.setattr(sys, "argv", ["assemble", "--queue-dir", str(qd)])
+    asm.main()
+    assert (results / "bench_r4_TPU_v5_lite.json").exists()
+    # second assembly from the same dir stays round 4
+    assert asm.derive_round(str(qd)) == 4
