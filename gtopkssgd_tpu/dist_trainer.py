@@ -61,6 +61,10 @@ def build_argparser() -> argparse.ArgumentParser:
                    choices=["auto", "exact", "blockwise", "approx",
                             "threshold", "pallas"])
     p.add_argument("--clip-grad-norm", type=float, default=None)
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="optimizer steps per jitted dispatch (lax.scan "
+                        "on-device); >1 amortizes per-step dispatch "
+                        "cost for small models")
     p.add_argument("--nsteps-update", type=int, default=1,
                    help="gradient accumulation micro-steps per comm round")
     p.add_argument("--max-epochs", type=int, default=140)
@@ -124,6 +128,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         topk_method=args.topk_method,
         clip_grad_norm=args.clip_grad_norm,
         nsteps_update=args.nsteps_update,
+        steps_per_dispatch=args.steps_per_dispatch,
         warmup_epochs=args.warmup_epochs,
         dense_warmup_epochs=args.dense_warmup_epochs,
         momentum_correction=args.momentum_correction,
@@ -159,13 +164,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.profile_dir:
             # SURVEY.md §5 tracing: the reference only had host timer
             # dicts; here a real jax.profiler device trace complements
-            # them. One step first so compilation stays out of the trace.
-            trainer.train(1)
+            # them. One dispatch first so compilation stays out of the
+            # trace; step counts round up to whole dispatches so the
+            # path composes with --steps-per-dispatch.
+            spd = trainer.cfg.steps_per_dispatch
+            warm = spd
+            traced = max(spd, -(-args.profile_steps // spd) * spd)
+            trainer.train(warm)
             jax.profiler.start_trace(args.profile_dir)
-            trainer.train(args.profile_steps)
+            trainer.train(traced)
             jax.profiler.stop_trace()
             trainer.logger.info("profiler: %d-step trace -> %s",
-                                args.profile_steps, args.profile_dir)
+                                traced, args.profile_dir)
         if args.num_iters is not None:
             stats = trainer.train(args.num_iters)
             stats.update(trainer.test())
