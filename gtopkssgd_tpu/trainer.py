@@ -116,18 +116,23 @@ class TrainConfig:
     steps_per_dispatch: int = 1    # optimizer steps per jitted dispatch:
                                    # >1 stages that many host batches and
                                    # lax.scan's the train step on-device,
-                                   # amortizing per-step dispatch cost
-                                   # (dominant for small models: measured
-                                   # ~28 s/step of host overhead on an
-                                   # 8-way 1-core CPU mesh, and the same
-                                   # effect bounds small-model steps on a
-                                   # real chip). Semantics identical to
+                                   # amortizing per-step dispatch cost.
+                                   # Pays only where dispatch DOMINATES —
+                                   # ms-scale steps on a real chip (a v5e
+                                   # runs ResNet-20-sized steps at 100s
+                                   # of dispatches/sec); measured NEUTRAL
+                                   # on the CPU meshes (steps are
+                                   # seconds: 5.5 vs 6.0 s/step at
+                                   # mesh8, 6.5 vs 7.5 at mesh2 — host
+                                   # overhead never dominates there).
+                                   # Semantics identical to
                                    # steps_per_dispatch=1 (per-step RNG,
                                    # warm-up cond, BPTT carry all thread
-                                   # through the scan); train() reports
-                                   # the dispatch's last-step loss, same
-                                   # as the per-step path reports its
-                                   # last step. num_iters must divide.
+                                   # through the scan; equality
+                                   # test-pinned); train() reports the
+                                   # dispatch's last-step loss, same as
+                                   # the per-step path reports its last
+                                   # step. num_iters must divide.
 
     # --- per-dataset defaults (the reference hardcoded these in DLTrainer) --
     def resolved(self) -> "TrainConfig":
