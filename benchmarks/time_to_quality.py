@@ -15,7 +15,8 @@ and writes benchmarks/results/time_to_quality_composed.json.
 
 What is measured vs projected, stated plainly:
   * steps_to_quality — MEASURED: steps to 90% of the dense loss drop,
-    identical-seed 8-way real-collective runs (convergence_* artifacts).
+    identical-seed multi-worker real-collective runs (convergence_*
+    artifacts; 2- or 8-way — each row names its source).
     The CPU-mesh runs use small batches; what transfers to the composition
     is the mode-relative step-count ratio, not the absolute count.
   * single-chip step time — MEASURED on the TPU chip (bench_r* artifact):
@@ -117,14 +118,27 @@ def steps_to_quality(paths: list[str], quality: float,
                 continue
             mode = m["mode"]
             # Prefer the longest-horizon artifact per mode (a 1200-step
-            # run supersedes a 600-step one for the same mode label).
+            # run supersedes a 600-step one); on a horizon TIE prefer
+            # the report with more arms (more internally-comparable
+            # context measured under one code state) — and RECORD the
+            # conflict so a tie never silently picks a side (two
+            # same-horizon artifacts can disagree across data-regime
+            # changes; the composed artifact must show that).
             prev = out.get(mode)
             horizon = report.get("steps", 0)
-            if prev is None or horizon > prev["horizon"]:
-                out[mode] = {"steps": steps,
-                             "src": os.path.basename(path),
-                             "horizon": horizon,
-                             "dense_steps": dense_here}
+            arms = len(report.get("modes", []))
+            cand = {"steps": steps, "src": os.path.basename(path),
+                    "horizon": horizon, "arms": arms,
+                    "dense_steps": dense_here, "conflicts": []}
+            if prev is None:
+                out[mode] = cand
+            elif (horizon, arms) > (prev["horizon"], prev["arms"]):
+                cand["conflicts"] = prev["conflicts"] + [
+                    {k: prev[k] for k in ("steps", "src", "horizon")}]
+                out[mode] = cand
+            elif horizon == prev["horizon"] and steps != prev["steps"]:
+                prev["conflicts"].append(
+                    {k: cand[k] for k in ("steps", "src", "horizon")})
     return out
 
 
@@ -138,9 +152,12 @@ def main():
     ap.add_argument("--batch-key", default="bs128",
                     help="which bench artifact block supplies step times")
     ap.add_argument("--convergence-glob",
-                    default="convergence_resnet20_*cpu_mesh8",
+                    default="convergence_resnet20_*cpu_mesh*",
                     help="one workload family only: steps-to-quality is "
-                         "judged against that family's own dense arm")
+                         "judged against that family's own dense arm "
+                         "(mesh2 + mesh8 artifacts mix safely — each "
+                         "mode's ratio pairs with its own artifact's "
+                         "dense arm)")
     ap.add_argument("--density", type=float, default=0.001)
     ap.add_argument("--ici-size", type=int, default=16)
     ap.add_argument("--ici-gbps", type=float, default=1600.0)
@@ -166,6 +183,12 @@ def main():
     conv_paths = sorted(glob.glob(
         os.path.join(RESULTS, args.convergence_glob + ".jsonl")))
     steps = steps_to_quality(conv_paths, args.quality, args.density)
+    for mode, rec in sorted(steps.items()):
+        for c in rec["conflicts"]:
+            print(f"# NOTE {mode}: using {rec['steps']} steps from "
+                  f"{rec['src']}; {c['src']} (same/shorter horizon) "
+                  f"measured {c['steps']} — conflict recorded in the "
+                  "artifact rows")
     if "dense" not in steps:
         raise SystemExit(f"no dense steps_to_{args.quality} row found in "
                          f"{len(conv_paths)} convergence artifacts")
@@ -226,6 +249,7 @@ def main():
                 "steps_to_quality": rec["steps"],
                 "steps_source": rec["src"],
                 "dense_steps_same_artifact": rec["dense_steps"],
+                "conflicting_measurements": rec["conflicts"] or None,
                 "overhead_source": ov_src,
                 "step_ms_projected": proj["step_ms"],
                 "comm_ms_projected": proj["comm_ms"],
@@ -252,14 +276,18 @@ def main():
             "dcn_constants_source": dcn_src,
             "ici_gbps": args.ici_gbps,
             "ici_size": args.ici_size,
-            "steps_note": ("steps_to_quality measured on 8-way CPU-mesh "
-                           "real-collective runs (ResNet-20-scale); the "
+            "steps_note": ("steps_to_quality measured on multi-worker "
+                           "CPU-mesh real-collective runs (ResNet-20 "
+                           "scale; 2- or 8-way — steps_source names the "
+                           "artifact, which records nworkers); the "
                            "mode-relative ratio is the transferable "
                            "quantity. vs_dense_time pairs each mode "
                            "with the dense arm of its OWN source "
                            "artifact (dense_steps_same_artifact) — the "
                            "quality target is defined per-artifact by "
-                           "that run's identical-seed dense curve"),
+                           "that run's identical-seed dense curve. "
+                           "conflicting_measurements lists same-horizon "
+                           "artifacts that disagree"),
         },
         "table": table,
     }
