@@ -685,7 +685,8 @@ class Trainer:
             return {"loss": float("nan"), "throughput": 0.0, "wall": 0.0}
         # Host-side mirror of state.step: reading int(self.state.step) would
         # block on the device every iteration and kill async IO/compute
-        # overlap; the mirror is exact (the step increments by 1 per call).
+        # overlap; the mirror is exact (the step increments by
+        # steps_per_dispatch per dispatch, and so does the mirror below).
         step = int(self.state.step)
         if self.cfg.prefetch > 0 and self._prefetch is None:
             # close() drained batches the worker had already pulled from
