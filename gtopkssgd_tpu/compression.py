@@ -44,7 +44,8 @@ Array = jax.Array
 class TopKCompressor:
     """Magnitude top-k with error feedback. `density` = k / N (reference flag
     `--density`, rho, typically 1e-3). `method` picks the selection kernel
-    (see ops.topk.select_topk): auto | exact | blockwise | approx | pallas."""
+    (see ops.topk.select_topk): auto | exact | blockwise | approx | pallas
+    | simrecall (the CPU-runnable pessimistic approx stand-in)."""
 
     density: float
     method: str = "auto"

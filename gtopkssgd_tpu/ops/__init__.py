@@ -11,6 +11,7 @@ from gtopkssgd_tpu.ops.topk import (
     blockwise_topk_abs,
     approx_topk_abs,
     threshold_topk_abs,
+    simrecall_topk_abs,
     select_topk,
     k_for_density,
     merge_sparse_sets,
@@ -24,6 +25,7 @@ __all__ = [
     "blockwise_topk_abs",
     "approx_topk_abs",
     "threshold_topk_abs",
+    "simrecall_topk_abs",
     "select_topk",
     "k_for_density",
     "merge_sparse_sets",

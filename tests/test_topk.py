@@ -131,3 +131,67 @@ def test_membership_mask():
     np.testing.assert_array_equal(
         np.asarray(membership_mask(q, s)), [True, False, False, True]
     )
+
+
+class TestSimrecall:
+    """ops.topk.simrecall_topk_abs — the CPU-runnable pessimistic model of
+    approx_max_k selection (round-4 verdict missing #2). These pin the
+    properties the convergence A/B leans on: real-but-imperfect recall,
+    backfill from the next ranks, and exact determinism per input."""
+
+    def test_valid_sparse_set(self, rng):
+        from gtopkssgd_tpu.ops import simrecall_topk_abs
+
+        x = rng.standard_normal(5000).astype(np.float32)
+        vals, idx = simrecall_topk_abs(jnp.asarray(x), 100)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        assert len(set(idx.tolist())) == 100  # unique, no sentinels needed
+        np.testing.assert_array_equal(x[idx], vals)
+
+    def test_recall_near_target(self, rng):
+        from gtopkssgd_tpu.ops import simrecall_topk_abs
+
+        x = rng.standard_normal(20000).astype(np.float32)
+        k = 1000
+        _, idx = simrecall_topk_abs(jnp.asarray(x), k)
+        true_k = set(np.argsort(-np.abs(x), kind="stable")[:k].tolist())
+        hit = len(true_k & set(np.asarray(idx).tolist())) / k
+        # Binomial(k=1000, p=0.95): std ~0.7%; 4 sigma on either side,
+        # and strictly below 1.0 — the selector must actually drop.
+        assert 0.91 <= hit <= 0.99
+
+    def test_backfill_comes_from_next_ranks(self, rng):
+        from gtopkssgd_tpu.ops import simrecall_topk_abs
+
+        x = rng.standard_normal(20000).astype(np.float32)
+        k = 1000
+        _, idx = simrecall_topk_abs(jnp.asarray(x), k)
+        order = np.argsort(-np.abs(x), kind="stable")
+        ranks = np.empty(len(x), np.int64)
+        ranks[order] = np.arange(len(x))
+        got = ranks[np.asarray(idx)]
+        # Every selected element sits within the exact top-(k+pad) pool.
+        pad = max(16, int(np.ceil(k * 0.05 * 4)))
+        assert got.max() < k + pad
+
+    def test_deterministic_per_input(self, rng):
+        from gtopkssgd_tpu.ops import simrecall_topk_abs
+
+        x = jnp.asarray(rng.standard_normal(4000).astype(np.float32))
+        v1, i1 = simrecall_topk_abs(x, 200)
+        v2, i2 = simrecall_topk_abs(x, 200)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+        # ...but the drop pattern is data-dependent: a different gradient
+        # drops a different set (mirrors approx misses moving step to step).
+        _, i3 = simrecall_topk_abs(x * 1.7 + 0.01, 200)
+        assert not np.array_equal(np.asarray(i1), np.asarray(i3))
+
+    def test_jit_and_dispatch(self, rng):
+        import jax
+
+        x = jnp.asarray(rng.standard_normal(3000).astype(np.float32))
+        f = jax.jit(lambda x: select_topk(x, 50, "simrecall"))
+        vals, idx = f(x)
+        assert vals.shape == (50,) and idx.shape == (50,)
+        assert idx.dtype == jnp.int32
