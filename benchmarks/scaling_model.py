@@ -41,11 +41,17 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-def _is_pow2(p: int) -> bool:
-    return p > 0 and (p & (p - 1)) == 0
+# The implemented tree's own round count (pow2: log2; ragged: masked
+# fold/unfold around the 2^m block) — imported from the collectives so
+# model and implementation cannot drift.
+from gtopkssgd_tpu.parallel import tree_rounds as _tree_rounds  # noqa: E402
 
 
 def _ring_allreduce_bytes(n_bytes: int, p: int) -> float:
@@ -87,13 +93,13 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
     s = min(ici_size, p)
     # ceil, not floor: p=24 with 16-chip slices IS a 2-slice job that
     # crosses DCN (a floor would model it as one all-ICI slice and
-    # charge zero DCN cost). Library callers (time_to_quality) can pass
-    # ragged P; this tool's own CLI still skips non-pow2 P because the
-    # implemented hypercube requires it (ragged axes fall back to the
-    # allgather class in parallel.collectives).
+    # charge zero DCN cost). Ragged counts are first-class since round 5:
+    # non-pow2 axes run the masked hypercube in-tree
+    # (parallel.collectives._merge_tree), log2(m) + 2 rounds with
+    # m = 2^floor(log2 x) — modeled by _tree_rounds (the
+    # implementation's own round count).
     n_slices = max(1, math.ceil(p / s))
-    dcn_rounds = (max(1, math.ceil(math.log2(n_slices)))
-                  if n_slices > 1 else 0)
+    dcn_rounds = _tree_rounds(n_slices)
 
     if mode == "dense":
         ici_ms = _ring_allreduce_bytes(4 * n, s) / ici_Bps * 1e3
@@ -108,9 +114,23 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
         # is expected LOWER than overhead_ms (no flat serial tail — the
         # [N] gradient never materializes; A/B on chip via
         # bench.py --compression gtopk_layerwise).
-        ici_rounds = max(1, math.ceil(math.log2(s))) if s > 1 else 0
+        # Split the flat tree's tree_rounds(p) by the link each round
+        # actually crosses: hypercube rounds whose XOR bit stays inside a
+        # slice pair ICI neighbors; larger bits — and the ragged
+        # fold/unfold, which spans slices whenever p > s — cross DCN.
+        # (p=24, s=16: 6 rounds total = 4 ICI + fold/unfold on DCN; the
+        # earlier tree_rounds(s)+tree_rounds(n_slices) split dropped one
+        # DCN round at exactly those ragged shapes.)
+        total_rounds = _tree_rounds(p)
+        if n_slices == 1:
+            ici_rounds, flat_dcn_rounds = total_rounds, 0
+        else:
+            m = 1 << (p.bit_length() - 1)
+            ici_rounds = int(math.log2(min(m, s)))
+            flat_dcn_rounds = total_rounds - ici_rounds
         comm_ms = (ici_rounds * (8 * k) / ici_Bps * 1e3
-                   + dcn_rounds * ((8 * k) / dcn_Bps * 1e3 + dcn_alpha_ms))
+                   + flat_dcn_rounds * ((8 * k) / dcn_Bps * 1e3
+                                        + dcn_alpha_ms))
         extra = overhead_ms
     elif mode == "allgather":
         comm_ms = ((8 * k * s) / ici_Bps * 1e3
@@ -175,11 +195,6 @@ def main():
                                            "ici_gbps", "dcn_gbps",
                                            "ici_size", "dcn_alpha_ms")}}))
     for p in args.ps:
-        if not _is_pow2(p):
-            print(f"# skipping P={p}: projection models the pow2 "
-                  f"hypercube; ragged P falls back to the allgather "
-                  f"class (see parallel.collectives)", file=sys.stderr)
-            continue
         for mode in ("dense", "gtopk", "allgather", "gtopk_hier"):
             print(json.dumps(project(mode, p, **kw)))
 

@@ -16,6 +16,7 @@ from gtopkssgd_tpu.parallel.collectives import (
     topk_allgather,
     sparse_allreduce,
     comm_bytes_per_step,
+    tree_rounds,
 )
 from gtopkssgd_tpu.parallel.mesh import make_mesh, dp_axis
 
@@ -27,6 +28,7 @@ __all__ = [
     "topk_allgather",
     "sparse_allreduce",
     "comm_bytes_per_step",
+    "tree_rounds",
     "make_mesh",
     "dp_axis",
 ]

@@ -120,8 +120,14 @@ def test_hier_tree_matches_slice_level_oracle(rng, ici):
     )
 
 
-def test_hier_non_pow2_slice_count_fallback(rng):
-    """p=6, ici=2 -> 3 slices: grouped allgather + exact reselect."""
+def test_hier_non_pow2_slice_count_masked_tree(rng):
+    """p=6, ici=2 -> 3 slices: the ragged slice count runs the same masked
+    tree as the flat mode (was a grouped-allgather exact reselect before
+    round 5) — oracle is the fold/hypercube/unfold numpy simulator over
+    the slice sets, and every device (both members of all 3 slices) must
+    agree bitwise."""
+    from tests.test_collectives import np_gtopk_ragged
+
     p, ici, k, n = 6, 2, 5, 100
     n_slices = p // ici
     svals, sidxs = make_local_sets(rng, p=n_slices, k=k, n=n)
@@ -129,16 +135,14 @@ def test_hier_non_pow2_slice_count_fallback(rng):
     idxs = np.repeat(sidxs, ici, axis=0)
 
     gv, gi = _run_hier(vals, idxs, p=p, k=k, n=n, ici=ici)
-    dense = np.zeros(n, np.float64)
-    for s in range(n_slices):
-        np.add.at(dense, sidxs[s], svals[s])
-    ov, oi = np_topk(dense.astype(np.float32), k)
-    want = np.zeros(n, np.float32)
-    want[oi] = ov
-    for d in range(p):
-        np.testing.assert_allclose(
-            _dense_of(gv[d], gi[d], n), want, rtol=1e-5, atol=1e-6
-        )
+    for d in range(1, p):
+        np.testing.assert_array_equal(gi[0], gi[d])
+        np.testing.assert_array_equal(gv[0], gv[d])
+    ov, oi = np_gtopk_ragged(list(svals), list(sidxs), k, n)
+    np.testing.assert_allclose(
+        _dense_of(gv[0], gi[0], n), _dense_of(ov[0], oi[0], n),
+        rtol=1e-5, atol=1e-6,
+    )
 
 
 def test_optimizer_hier_equals_gtopk_over_slice_sums(rng):
