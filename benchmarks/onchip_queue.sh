@@ -17,7 +17,7 @@ mkdir -p "$OUT"
 # run front-to-back, so a fresh drain re-measures everything anyway, and
 # leftovers must not be mistaken for this drain's results by the
 # assemble stage (it also applies its own staleness filter).
-rm -f "$OUT"/bench_bs*.json
+rm -f "$OUT"/bench_bs*.json "$OUT"/mfu_ablation.jsonl "$OUT"/*.log
 log() { echo "[onchip_queue $(date -u +%H:%M:%S)] $*"; }
 
 log "probe"
@@ -54,19 +54,23 @@ log "assemble committed bench artifact from whatever stages succeeded"
 python benchmarks/assemble_bench_artifact.py --queue-dir "$OUT"
 log "assemble rc=$?"
 
+log "mfu ablation ladder (round-5 verdict #3: decompose the 0.26 dense MFU by ablation; profiler op-attribution is dead on this platform)"
+python benchmarks/mfu_ablation.py > "$OUT/mfu_ablation.jsonl" 2> "$OUT/mfu_ablation.log"
+log "mfu ablation rc=$?"
+
 log "convergence (5 arms)"
 python benchmarks/convergence_run.py --dnn resnet20 --steps 1200 \
     --modes dense,gtopk,allgather,gtopk_layerwise,gtopk+corr \
     --density 0.001 > "$OUT/convergence.log" 2>&1
 log "convergence rc=$?"
 
-log "an4 convergence (chip-only: ~70 s/step on the 1-core host CPU mesh)"
-python benchmarks/convergence_run.py --dnn lstman4 --steps 200 --chunk 20 \
-    --batch-size 8 --modes dense,gtopk --density 0.001 \
-    --eval-batches 8 > "$OUT/convergence_an4.log" 2>&1
-log "an4 rc=$?"
+log "resnet50 synthetic-imagenet convergence (round-5 verdict #5: first ImageNet-workload convergence evidence; 25.6M params => the auto policy routes selection through approx_max_k, so this is ALSO the production approx path's first convergence run)"
+python benchmarks/convergence_run.py --dnn resnet50 --steps 1500 --chunk 50 \
+    --batch-size 64 --modes dense,gtopk+corr --density 0.001 \
+    --eval-batches 8 > "$OUT/convergence_resnet50.log" 2>&1
+log "resnet50 rc=$?"
 
-log "vgg16 convergence (also ~23 s/step on the host CPU mesh)"
+log "vgg16 convergence (~23 s/step on the host CPU mesh; before an4 — it carries the exact-vs-approx A/B)"
 # gtopk+corr auto-routes selection to approx_max_k at 15M params — the
 # first conv-net convergence through the production approx path; the
 # +exact arm is the same config through exact lax.top_k, making this the
@@ -76,5 +80,11 @@ python benchmarks/convergence_run.py --dnn vgg16 --steps 600 --chunk 25 \
     --density 0.001 \
     --eval-batches 16 > "$OUT/convergence_vgg16.log" 2>&1
 log "vgg16 rc=$?"
+
+log "an4 convergence (chip-only: ~70 s/step on the 1-core host CPU mesh)"
+python benchmarks/convergence_run.py --dnn lstman4 --steps 200 --chunk 20 \
+    --batch-size 8 --modes dense,gtopk --density 0.001 \
+    --eval-batches 8 > "$OUT/convergence_an4.log" 2>&1
+log "an4 rc=$?"
 
 log "queue done"

@@ -26,8 +26,8 @@ EOF
         # Bound the drain: a tunnel that wedges MID-drain (rounds 2+3
         # failure mode) would otherwise hang this loop forever and
         # silently miss the next window. A full healthy drain is ~60-90
-        # min; 2.5h of wedge means the window is gone anyway.
-        timeout 9000 bash benchmarks/onchip_queue.sh "$OUT"
+        # min (longer with the round-5 mfu + resnet50 stages; 3.5h cap).
+        timeout 12600 bash benchmarks/onchip_queue.sh "$OUT"
         rc=$?
         log "queue rc=$rc"
         if [ "$rc" -eq 0 ]; then

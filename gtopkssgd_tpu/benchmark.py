@@ -137,6 +137,31 @@ def _timeit(fn: Callable, args, steps: int) -> float:
     return sec
 
 
+def time_compiled_step(compiled, state, batch, min_seconds: float):
+    """The one honest timing loop for a compiled ``state, aux = f(state,
+    batch)`` step: 3 warmup steps, a D2H round-trip fence (true_sync —
+    block_until_ready acks before execution on the tunneled platform),
+    then a >= min_seconds window whose clock stops only after the FULL
+    final state is executed, rtt subtracted (utils/timers.py discipline).
+    Shared by measure_throughput and benchmarks/mfu_ablation.py so the
+    protocol cannot drift between artifacts. Returns (sec_per_step,
+    steps_timed, final_state)."""
+    for _ in range(3):
+        state, _ = compiled(state, batch)
+    rtt = sync_round_trip_seconds(state)
+    box = [state]
+
+    def chunk(c):
+        s = box[0]
+        for _ in range(c):
+            s, _ = compiled(s, batch)
+        true_sync(s)
+        box[0] = s
+
+    sec, steps = timed_window(chunk, rtt, min_seconds, 8)
+    return sec, steps, box[0]
+
+
 def measure_throughput(cfg: BenchConfig, mode: Optional[str],
                        density: float) -> Dict[str, float]:
     """Fused-step images/sec/chip for one (mode, density) point.
@@ -216,25 +241,8 @@ def measure_throughput(cfg: BenchConfig, mode: Optional[str],
 
     compiled = fn.lower(state, batch).compile()
     flops_per_step = _compiled_flops(compiled)
-
-    # Warmup: a few real steps, fenced with a D2H read (true_sync) — on
-    # the tunneled platform block_until_ready returns before execution.
-    for _ in range(3):
-        state, loss = compiled(state, batch)
-    rtt = sync_round_trip_seconds(state)
-
-    # Shared honest timing loop; the clock stops only after the FULL final
-    # state (params + residual + momentum) is executed.
-    box = [state]
-
-    def chunk(c):
-        s = box[0]
-        for _ in range(c):
-            s, _ = compiled(s, batch)
-        true_sync(s)
-        box[0] = s
-
-    sec, steps = timed_window(chunk, rtt, cfg.min_seconds, 8)
+    sec, steps, _ = time_compiled_step(compiled, state, batch,
+                                       cfg.min_seconds)
 
     from gtopkssgd_tpu.optimizer import wire_k
 

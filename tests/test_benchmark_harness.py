@@ -66,3 +66,22 @@ def test_measure_throughput_s2d_resnet50_traces():
     y = jnp.zeros((2,), jnp.int32)
     lowered = jax.jit(step).lower(params, opt0, x, y)
     assert "module" in lowered.as_text()[:200]  # produced StableHLO
+
+
+def test_mfu_ablation_rung_measures_off_chip():
+    """One rung of the MFU ablation ladder end-to-end on a tiny model:
+    the measurement dict must carry the ladder's analysis fields and an
+    XLA-counted FLOPs number (the chip run reuses exactly this path)."""
+    from tests.conftest import load_benchmark_module
+
+    _measure_rung = load_benchmark_module("mfu_ablation")._measure_rung
+
+    row = _measure_rung("fwd_bwd", 4, 0.05, dnn="resnet20")
+    assert row["rung"] == "fwd_bwd" and row["batch_size"] == 4
+    assert row["flops_per_step"] and row["flops_per_step"] > 0
+    assert row["sec_per_step"] > 0
+    assert row["steps_timed"] >= 8
+
+    full = _measure_rung("full", 4, 0.05, dnn="resnet20")
+    # backward ~2x forward FLOPs; full adds only the elementwise update
+    assert full["flops_per_step"] >= row["flops_per_step"]
