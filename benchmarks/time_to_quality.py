@@ -84,12 +84,17 @@ def latest_bench_artifact() -> tuple[str, dict]:
 
 
 def steps_to_quality(paths: list[str], quality: float,
-                     density: float) -> dict:
+                     density: float, synth_hard: bool = False) -> dict:
     """mode -> (steps, source artifact) from convergence report rows.
 
     Only rows at the requested sparse density (or dense, density=1.0)
     enter: a rho=0.01 run converges far faster than rho=0.001 and must
-    not leak into a rho=0.001 composition.
+    not leak into a rho=0.001 composition. Same rule for the task
+    variant: the hard synthetic task is calibrated to produce DIFFERENT
+    steps-to-quality, so easy- and hard-task artifacts must never mix in
+    one composition — reports carry a synth_hard marker (absent = easy,
+    the pre-round-5 capture default) and only the requested variant
+    enters.
     """
     key = f"steps_to_{quality}_of_dense_drop"
     out = {}
@@ -101,6 +106,8 @@ def steps_to_quality(paths: list[str], quality: float,
             continue
         report = next((r for r in rows if r.get("kind") == "report"), None)
         if not report:
+            continue
+        if bool(report.get("synth_hard", False)) != synth_hard:
             continue
         # The dense arm FROM THE SAME artifact is each sparse mode's
         # fair baseline: the 90%-of-drop target is defined by that run's
@@ -159,6 +166,12 @@ def main():
                          "mode's ratio pairs with its own artifact's "
                          "dense arm)")
     ap.add_argument("--density", type=float, default=0.001)
+    ap.add_argument("--synth-hard", action="store_true",
+                    help="compose from HARD-task convergence artifacts "
+                         "(reports marked synth_hard) instead of the "
+                         "easy-task captures; the two tasks' "
+                         "steps-to-quality are not comparable and never "
+                         "mix")
     ap.add_argument("--ici-size", type=int, default=16)
     ap.add_argument("--ici-gbps", type=float, default=1600.0)
     ap.add_argument("--out", default=os.path.join(
@@ -182,7 +195,8 @@ def main():
 
     conv_paths = sorted(glob.glob(
         os.path.join(RESULTS, args.convergence_glob + ".jsonl")))
-    steps = steps_to_quality(conv_paths, args.quality, args.density)
+    steps = steps_to_quality(conv_paths, args.quality, args.density,
+                             synth_hard=args.synth_hard)
     for mode, rec in sorted(steps.items()):
         for c in rec["conflicts"]:
             print(f"# NOTE {mode}: using {rec['steps']} steps from "
@@ -195,7 +209,7 @@ def main():
 
     # Comm constants: the dcn_probe fit when present, else the published
     # defaults scaling_model documents.
-    dcn_gbps, dcn_alpha_ms, dcn_src = 25.0, 0.0, "default"
+    dcn_gbps, dcn_alpha_ms, dcn_src, fit = 25.0, 0.0, "default", None
     probe_path = os.path.join(RESULTS, "dcn_probe_2proc.json")
     if os.path.exists(probe_path):
         with open(probe_path) as fh:
@@ -209,15 +223,37 @@ def main():
             dcn_gbps = probe["measured_cross_process_gbps"]
             dcn_src = "dcn_probe_2proc.json (bandwidth only)"
 
+    # Alpha reconciliation (round-4 verdict weak #3 / next-round #8): the
+    # 2-proc fit says alpha=3.66 ms, the 4-proc fit 21.9 ms — a 6x gap
+    # that is the 1-core host's self-contention signature (P processes
+    # timeshare one core, so per-message latency includes scheduler
+    # queueing that grows superlinearly with P), not a property of any
+    # network. Neither number is a NIC alpha. The honest composition
+    # BRACKETS: every row is computed at the 2-proc anchor AND at the
+    # alpha=0 bandwidth-only floor, and the quotable headline is the
+    # per-row MIN — whichever end is less favorable to that mode at that
+    # P (the direction is shape-dependent: at bandwidth-dominated slice
+    # counts zeroing alpha helps dense more than gtopk and the anchor is
+    # the conservative end, e.g. the committed p=32 rows).
+    alpha_bracket = {"floor_alpha0": 0.0,
+                     "anchor_2proc_ms": dcn_alpha_ms if fit else None}
+    probe4_path = os.path.join(RESULTS, "dcn_probe_4proc.json")
+    if os.path.exists(probe4_path):
+        with open(probe4_path) as fh:
+            fit4 = json.load(fh).get("alpha_beta_fit") or {}
+        alpha_bracket["contended_4proc_ms"] = fit4.get("alpha_ms")
+
     sm = _load_scaling_model()
     kw = dict(n=n, k=k, compute_ms=compute_ms, overhead_ms=overhead_ms,
               ici_gbps=args.ici_gbps, dcn_gbps=dcn_gbps,
               dcn_alpha_ms=dcn_alpha_ms, ici_size=args.ici_size,
               batch=batch)
 
+    kw0 = {**kw, "dcn_alpha_ms": 0.0}  # bandwidth-only floor of the bracket
     table = []
     for p in args.ps:
         dense_proj = sm.project("dense", p, **kw)
+        dense_proj0 = sm.project("dense", p, **kw0)
         for mode, rec in sorted(steps.items()):
             wire = wire_mode(mode)
             if wire is None:
@@ -228,20 +264,24 @@ def main():
             # rows use the corr bench block's own overhead when the
             # on-chip queue has measured it.
             if "+corr" in mode and corr_overhead_ms is not None:
-                proj = sm.project(wire, p,
-                                  **{**kw, "overhead_ms": corr_overhead_ms})
-                ov_src = f"{args.batch_key}_corr bench block"
+                ov, ov_src = corr_overhead_ms, f"{args.batch_key}_corr bench block"
             else:
-                proj = sm.project(wire, p, **kw)
+                ov = kw["overhead_ms"]
                 ov_src = (f"{args.batch_key} gtopk block (corr step cost "
                           "unmeasured on-chip)"
                           if "+corr" in mode else f"{args.batch_key} block")
+            proj = sm.project(wire, p, **{**kw, "overhead_ms": ov})
+            proj0 = sm.project(wire, p, **{**kw0, "overhead_ms": ov})
             t_min = rec["steps"] * proj["step_ms"] / 1e3 / 60
+            t_min0 = rec["steps"] * proj0["step_ms"] / 1e3 / 60
             # Ratio vs the SAME artifact's dense arm (fair target);
             # falls back to the longest-horizon dense arm if the source
             # artifact had no dense row reaching the quality.
             dense_steps = rec["dense_steps"] or steps["dense"]["steps"]
             dense_t_min = dense_steps * dense_proj["step_ms"] / 1e3 / 60
+            dense_t_min0 = dense_steps * dense_proj0["step_ms"] / 1e3 / 60
+            vs = round(dense_t_min / t_min, 3) if t_min else None
+            vs0 = round(dense_t_min0 / t_min0, 3) if t_min0 else None
             table.append({
                 "p": p,
                 "mode": mode,
@@ -254,8 +294,13 @@ def main():
                 "step_ms_projected": proj["step_ms"],
                 "comm_ms_projected": proj["comm_ms"],
                 "time_to_quality_min": round(t_min, 2),
-                "vs_dense_time": round(dense_t_min / t_min, 3)
-                if t_min else None,
+                "vs_dense_time": vs,
+                "vs_dense_time_alpha0": vs0,
+                # the quotable number: the bracket end less favorable to
+                # this mode (see alpha reconciliation note above)
+                "vs_dense_time_conservative": (
+                    min(vs, vs0) if vs is not None and vs0 is not None
+                    else vs or vs0),
             })
 
     report = {
@@ -274,6 +319,21 @@ def main():
             "dcn_gbps": dcn_gbps,
             "dcn_alpha_ms": dcn_alpha_ms,
             "dcn_constants_source": dcn_src,
+            "dcn_alpha_bracket": {
+                **alpha_bracket,
+                "note": ("the 2-proc and 4-proc localhost fits disagree "
+                         "~6x on alpha — the 1-core host's "
+                         "self-contention signature, not a NIC property; "
+                         "every row therefore carries vs_dense_time at "
+                         "the 2-proc anchor AND at the alpha=0 "
+                         "bandwidth-only floor, and "
+                         "vs_dense_time_conservative = min of the two — "
+                         "whichever end is less favorable to the mode at "
+                         "that P (the direction depends on how many "
+                         "per-message latencies each mode pays at that "
+                         "slice shape; quote ONLY the conservative "
+                         "column)"),
+            },
             "ici_gbps": args.ici_gbps,
             "ici_size": args.ici_size,
             "steps_note": ("steps_to_quality measured on multi-worker "
@@ -296,14 +356,15 @@ def main():
         json.dump(report, fh, indent=2)
         fh.write("\n")
     hdr = f"{'P':>4} {'mode':<16} {'steps':>6} {'step_ms':>9} " \
-          f"{'t_qual_min':>11} {'vs dense':>9}"
+          f"{'t_qual_min':>11} {'vs dense':>9} {'conserv.':>9}"
     print(hdr)
     for row in table:
         print(f"{row['p']:>4} {row['mode']:<16} "
               f"{row['steps_to_quality']:>6} "
               f"{row['step_ms_projected']:>9.2f} "
               f"{row['time_to_quality_min']:>11.2f} "
-              f"{row['vs_dense_time']:>9.3f}")
+              f"{row['vs_dense_time']:>9.3f} "
+              f"{row['vs_dense_time_conservative']:>9.3f}")
     print(f"wrote {out}")
 
 

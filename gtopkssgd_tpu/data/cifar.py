@@ -57,11 +57,25 @@ def _load_real(data_dir: str, split: str):
     )
 
 
-@functools.lru_cache(maxsize=4)
-def _synthetic(split: str, seed: int):
+@functools.lru_cache(maxsize=8)
+def _synthetic(split: str, seed: int, hard: bool = False):
     """Class-conditional Gaussian images: separable, so loss curves mean
     something even without real data. Cached so the P per-rank dataset
-    objects in one SPMD process share one array, not P copies."""
+    objects in one SPMD process share one array, not P copies.
+
+    ``hard`` switches to the DISCRIMINATIVE variant (round-4 verdict
+    missing #6: on the easy task every arm saturates val_top1=1.0 by
+    step ~300 at the 1200-step budget, so accuracy parity between
+    optimizer arms was unfalsifiable). Two changes: the class signal is
+    a full 32x32x3 spatial pattern at low amplitude instead of a flat
+    per-channel offset 6x stronger (augmentation crops/flips now
+    actually perturb the signal, and the model must learn a pattern
+    detector rather than an average-color probe), and 10% of TRAIN
+    labels are resampled uniformly (test stays clean) so blind
+    memorization costs clean-eval accuracy. Calibrated so the dense arm
+    is still climbing at 1200 steps on the 2-way mesh rather than
+    pinned at 1.0 — arms can separate.
+    """
     n = SYNTH_TRAIN if split == "train" else SYNTH_TEST
     rng = np.random.default_rng(np.random.SeedSequence([seed, _split_id(split)]))
     labels = rng.integers(0, 10, n).astype(np.int32)
@@ -69,12 +83,24 @@ def _synthetic(split: str, seed: int):
     # test must share the class signal or held-out eval on synthetic data is
     # structurally chance-level (the bug that made every synthetic val_top1
     # read ~0.1 before this).
-    offsets = _signal_rng(seed).standard_normal((10, 3)).astype(np.float32) * 0.25
+    if hard:
+        patterns = _signal_rng(seed).standard_normal(
+            (10, 32, 32, 3)).astype(np.float32) * 0.07
+        signal = patterns[labels]
+    else:
+        offsets = (_signal_rng(seed).standard_normal((10, 3))
+                   .astype(np.float32) * 0.25)
+        signal = offsets[labels][:, None, None, :]
     images = 0.5 + 0.15 * rng.standard_normal((n, 32, 32, 3)).astype(np.float32)
-    images += offsets[labels][:, None, None, :]
+    images += signal
     images = np.clip(images, 0.0, 1.0)
+    out_labels = labels
+    if hard and split == "train":
+        noisy = rng.random(n) < 0.10
+        out_labels = np.where(
+            noisy, rng.integers(0, 10, n).astype(np.int32), labels)
     # quantize once to the uint8 wire format (what real pickles hold)
-    return (images * 255.0).round().astype(np.uint8), labels
+    return (images * 255.0).round().astype(np.uint8), out_labels
 
 
 class CIFAR10Dataset:
@@ -82,7 +108,7 @@ class CIFAR10Dataset:
     example_shape = (32, 32, 3)
 
     def __init__(self, *, split="train", batch_size=32, rank=0, nworkers=1,
-                 data_dir=None, seed=0, augment=None):
+                 data_dir=None, seed=0, augment=None, synth_hard=False):
         self.split = split
         self.batch_size = batch_size
         self.augment = (split == "train") if augment is None else augment
@@ -91,7 +117,8 @@ class CIFAR10Dataset:
             os.path.join(root, "cifar-10-batches-py")
         )
         if self.synthetic:
-            self.images, self.labels = _synthetic(split, seed)
+            self.images, self.labels = _synthetic(split, seed,
+                                                  hard=synth_hard)
         else:
             self.images, self.labels = _load_real(root, split)
         self.partitioner = DataPartitioner(

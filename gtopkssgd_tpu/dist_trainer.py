@@ -91,6 +91,11 @@ def build_argparser() -> argparse.ArgumentParser:
                         "width)")
     p.add_argument("--num-iters", type=int, default=None,
                    help="train a fixed number of steps instead of epochs")
+    p.add_argument("--synth-hard", action="store_true",
+                   help="synthetic CIFAR only: the discriminative variant "
+                        "(weak spatial class signal + 10%% train label "
+                        "noise; data/cifar.py) — arms can separate on "
+                        "val accuracy instead of saturating at 1.0")
     p.add_argument("--eval-batches", type=int, default=None)
     p.add_argument("--log-interval", type=int, default=50)
     p.add_argument("--prefetch", type=int, default=2,
@@ -139,6 +144,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         seed=args.seed,
         dtype=args.dtype,
         space_to_depth=args.s2d,
+        synth_hard=args.synth_hard,
         eval_batches=args.eval_batches,
         log_interval=args.log_interval,
         prefetch=args.prefetch,

@@ -102,6 +102,11 @@ class TrainConfig:
                                    # models/resnet.py and the equivalence
                                    # test)
     eval_batches: Optional[int] = None   # cap eval batches (None = full)
+    synth_hard: bool = False       # synthetic CIFAR only: the
+                                   # discriminative variant (weak spatial
+                                   # class patterns + 10% train label
+                                   # noise) — see data/cifar.py::_synthetic;
+                                   # no effect with real data present
     log_interval: int = 50
     prefetch: int = 2              # host batches assembled ahead by a
                                    # background thread (0 = synchronous;
@@ -226,6 +231,8 @@ class Trainer:
         )
         if cfg.dataset == "imagenet" and cfg.decode_workers > 0:
             data_kw["decode_workers"] = cfg.decode_workers
+        if cfg.dataset == "cifar10" and cfg.synth_hard:
+            data_kw["synth_hard"] = True
         self.train_shards = [
             get_dataset(cfg.dataset, split="train", rank=r,
                         nworkers=cfg.nworkers, **data_kw)

@@ -153,3 +153,54 @@ def test_synthetic_class_signal_shared_across_splits():
         for c in common
     ]
     assert np.mean(cos) > 0.5, np.mean(cos)
+
+
+class TestSynthHard:
+    """The discriminative synthetic-CIFAR variant (data/cifar.py::_synthetic
+    hard=True): weak spatial class patterns + train-only label noise."""
+
+    def test_train_label_noise_rate(self):
+        from gtopkssgd_tpu.data.cifar import _synthetic
+
+        _, easy = _synthetic("train", seed=7)
+        _, hard = _synthetic("train", seed=7, hard=True)
+        flipped = (easy != hard).mean()
+        # 10% resampled uniformly over 10 classes -> ~9% actually differ
+        assert 0.05 < flipped < 0.14, flipped
+
+    def test_test_split_labels_clean_and_signal_shared(self):
+        from gtopkssgd_tpu.data.cifar import _synthetic
+
+        imgs_a, lab_a = _synthetic("test", seed=7, hard=True)
+        # test-split labels must be CLEAN (noise is train-only)
+        import numpy as _np
+        _np.testing.assert_array_equal(lab_a, _synthetic("test", seed=7)[1])
+        # class signal must be split-independent: average image of one
+        # class in train and test must correlate (shared pattern), while
+        # two different classes must not
+        timgs, tlab = _synthetic("train", seed=7, hard=True)
+        import numpy as np
+
+        def class_mean(imgs, lab, c):
+            m = imgs[lab == c].astype(np.float32).mean(0)
+            return (m - m.mean()).ravel()
+
+        same = np.corrcoef(class_mean(imgs_a, lab_a, 3),
+                           class_mean(timgs, tlab, 3))[0, 1]
+        diff = np.corrcoef(class_mean(imgs_a, lab_a, 3),
+                           class_mean(timgs, tlab, 4))[0, 1]
+        assert same > 0.3 and abs(diff) < 0.2, (same, diff)
+
+    def test_signal_is_spatial_not_flat(self):
+        from gtopkssgd_tpu.data.partition import signal_rng
+        import numpy as np
+
+        pat = signal_rng(7).standard_normal((10, 32, 32, 3)) * 0.07
+        # per-class pattern varies across pixels (a flat offset would not)
+        assert np.std(pat[0], axis=(0, 1)).min() > 0.01
+
+    def test_trainer_plumbing(self):
+        from gtopkssgd_tpu.trainer import TrainConfig
+
+        cfg = TrainConfig(dnn="resnet20", synth_hard=True).resolved()
+        assert cfg.synth_hard and cfg.dataset == "cifar10"

@@ -94,3 +94,24 @@ def test_composes_from_committed_artifacts(tmp_path):
     assert sparse32, "no sparse rows composed"
     for r in sparse32:
         assert r["step_ms_projected"] < dense[32]["step_ms_projected"]
+
+
+def test_alpha_bracket_fields():
+    """Round-5 verdict #8: the composed artifact must carry the
+    contention-bounded alpha bracket and a conservative per-row quote =
+    min(anchor, alpha0) — never silently the favorable end."""
+    import json
+    import os
+
+    out = os.path.join(REPO, "benchmarks", "results",
+                       "time_to_quality_composed.json")
+    assert os.path.exists(out), "composed artifact missing"
+    with open(out) as fh:
+        d = json.load(fh)
+    br = d["factors"]["dcn_alpha_bracket"]
+    assert br["floor_alpha0"] == 0.0
+    assert br["anchor_2proc_ms"] and br["contended_4proc_ms"]
+    assert br["contended_4proc_ms"] > 2 * br["anchor_2proc_ms"]  # the 6x gap
+    for row in d["table"]:
+        vs, vs0 = row["vs_dense_time"], row["vs_dense_time_alpha0"]
+        assert row["vs_dense_time_conservative"] == min(vs, vs0)
