@@ -134,18 +134,26 @@ def steps_to_quality(paths: list[str], quality: float,
             prev = out.get(mode)
             horizon = report.get("steps", 0)
             arms = len(report.get("modes", []))
+            # regime context rides along so a recorded conflict shows
+            # WHETHER the disagreement crosses worker regimes (the
+            # round-4 450-vs-1100 warmup "conflict" paired 2x16 against
+            # 8x4 — same global batch, different tree depth and
+            # per-device BN batch; that is a regime difference, not a
+            # measurement error)
+            regime = {"nworkers": report.get("nworkers"),
+                      "batch_size": report.get("batch_size")}
             cand = {"steps": steps, "src": os.path.basename(path),
-                    "horizon": horizon, "arms": arms,
+                    "horizon": horizon, "arms": arms, **regime,
                     "dense_steps": dense_here, "conflicts": []}
+            ckeys = ("steps", "src", "horizon", "nworkers", "batch_size")
             if prev is None:
                 out[mode] = cand
             elif (horizon, arms) > (prev["horizon"], prev["arms"]):
                 cand["conflicts"] = prev["conflicts"] + [
-                    {k: prev[k] for k in ("steps", "src", "horizon")}]
+                    {k: prev[k] for k in ckeys}]
                 out[mode] = cand
             elif horizon == prev["horizon"] and steps != prev["steps"]:
-                prev["conflicts"].append(
-                    {k: cand[k] for k in ("steps", "src", "horizon")})
+                prev["conflicts"].append({k: cand[k] for k in ckeys})
     return out
 
 
@@ -288,6 +296,8 @@ def main():
                 "wire_mode": wire,
                 "steps_to_quality": rec["steps"],
                 "steps_source": rec["src"],
+                "steps_regime": {"nworkers": rec["nworkers"],
+                                 "batch_size": rec["batch_size"]},
                 "dense_steps_same_artifact": rec["dense_steps"],
                 "conflicting_measurements": rec["conflicts"] or None,
                 "overhead_source": ov_src,

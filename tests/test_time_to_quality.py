@@ -115,3 +115,41 @@ def test_alpha_bracket_fields():
     for row in d["table"]:
         vs, vs0 = row["vs_dense_time"], row["vs_dense_time_alpha0"]
         assert row["vs_dense_time_conservative"] == min(vs, vs0)
+
+
+def test_conflict_records_carry_regime_context(tmp_path):
+    """Two same-horizon artifacts that disagree on steps: the losing side
+    must land in conflicts WITH its worker regime (nworkers/batch_size),
+    and the winner's regime must be readable from its record — so a
+    450-vs-1100-style disagreement is classifiable as regime-vs-
+    measurement without opening the source artifacts."""
+    import json
+
+    ttq = _load()
+
+    def write(name, nworkers, batch, steps_to_q, arms):
+        rows = []
+        modes = []
+        for m, s in steps_to_q.items():
+            modes.append({"mode": m, "density": 1.0 if m == "dense"
+                          else 0.001, "steps_to_0.9_of_dense_drop": s})
+        rows.append({"kind": "report", "dnn": "resnet20", "steps": 1200,
+                     "batch_size": batch, "nworkers": nworkers,
+                     "modes": modes[:arms]})
+        p = tmp_path / name
+        with open(p, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+        return str(p)
+
+    a = write("a_mesh2.jsonl", 2, 16,
+              {"dense": 300, "gtopk+warmup": 450}, arms=2)
+    b = write("b_mesh8.jsonl", 8, 4,
+              {"dense": 450, "gtopk+warmup": 1100}, arms=2)
+    out = ttq.steps_to_quality([a, b], "0.9", 0.001)
+    w = out["gtopk+warmup"]
+    # same horizon + same arm count: first-seen wins, other side recorded
+    assert w["steps"] == 450 and w["nworkers"] == 2 and w["batch_size"] == 16
+    assert w["conflicts"] == [{"steps": 1100, "src": "b_mesh8.jsonl",
+                               "horizon": 1200, "nworkers": 8,
+                               "batch_size": 4}]
