@@ -150,6 +150,37 @@ def test_conflict_records_carry_regime_context(tmp_path):
     w = out["gtopk+warmup"]
     # same horizon + same arm count: first-seen wins, other side recorded
     assert w["steps"] == 450 and w["nworkers"] == 2 and w["batch_size"] == 16
-    assert w["conflicts"] == [{"steps": 1100, "src": "b_mesh8.jsonl",
-                               "horizon": 1200, "nworkers": 8,
-                               "batch_size": 4}]
+    # CROSS-regime disagreement classifies as a regime VARIANT (the
+    # re-measured-and-reproduced 450-vs-1100 case), not a conflict
+    assert w["conflicts"] == []
+    assert w["regime_variants"] == [{"steps": 1100, "src": "b_mesh8.jsonl",
+                                     "horizon": 1200, "nworkers": 8,
+                                     "batch_size": 4}]
+    # SAME-regime disagreement stays a real conflict
+    c = write("c_mesh2.jsonl", 2, 16,
+              {"dense": 310, "gtopk+warmup": 700}, arms=2)
+    out2 = ttq.steps_to_quality([a, c], "0.9", 0.001)
+    w2 = out2["gtopk+warmup"]
+    assert w2["regime_variants"] == []
+    assert [e["steps"] for e in w2["conflicts"]] == [700]
+
+    # Supersede re-classifies inherited entries against the NEW winner:
+    # a+c disagree same-regime (2x16); a longer-horizon 8x4 artifact d
+    # then wins, and BOTH inherited 2x16 entries must re-land as regime
+    # variants of d (not stay labeled conflicts of a 2x16 winner)
+    import json as _json
+    rows = [{"kind": "report", "dnn": "resnet20", "steps": 2000,
+             "batch_size": 4, "nworkers": 8,
+             "modes": [{"mode": "dense", "density": 1.0,
+                        "steps_to_0.9_of_dense_drop": 500},
+                       {"mode": "gtopk+warmup", "density": 0.001,
+                        "steps_to_0.9_of_dense_drop": 1500}]}]
+    dpath = tmp_path / "d_mesh8_long.jsonl"
+    with open(dpath, "w") as fh:
+        for r in rows:
+            fh.write(_json.dumps(r) + "\n")
+    out3 = ttq.steps_to_quality([a, c, str(dpath)], "0.9", 0.001)
+    w3 = out3["gtopk+warmup"]
+    assert w3["steps"] == 1500 and w3["nworkers"] == 8
+    assert w3["conflicts"] == []
+    assert sorted(e["steps"] for e in w3["regime_variants"]) == [450, 700]
