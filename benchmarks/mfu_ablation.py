@@ -133,7 +133,9 @@ def _measure_rung(rung: str, batch_size: int, min_seconds: float,
     else:
         mom0 = jax.tree.map(jnp.zeros_like, params)
     state = (params, bstats, mom0)
-    fn = jax.jit(step, donate_argnums=(0,))
+    from gtopkssgd_tpu.utils import safe_donate
+
+    fn = jax.jit(step, donate_argnums=safe_donate(0))
     compiled = fn.lower(state, x).compile()
     flops = _compiled_flops(compiled)
     sec, steps, _ = time_compiled_step(compiled, state, x, min_seconds)
@@ -180,7 +182,17 @@ def _run_child(rung: str, batch_size: int, extra_flag: str,
     if out.returncode != 0:
         return {"rung": rung, "batch_size": batch_size,
                 "xla_flags": extra_flag, "error": out.stderr[-500:]}
-    return json.loads(out.stdout.strip().splitlines()[-1])
+    try:
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        # RC=0 with garbage stdout happens: a child that died in a C
+        # extension after printing warnings, or a wrapper that swallowed
+        # the JSON line. Same policy as the timeout above — one error row,
+        # not a crashed ladder.
+        return {"rung": rung, "batch_size": batch_size,
+                "xla_flags": extra_flag,
+                "error": "malformed child stdout: "
+                         + out.stdout.strip()[-300:]}
 
 
 def main():

@@ -34,3 +34,43 @@ Layer map (mirrors SURVEY.md; reference layer in parens):
 """
 
 __version__ = "0.1.0"
+
+# ---------------------------------------------------------------- jax compat
+# The codebase targets the public `jax.shard_map(..., check_vma=...)` and
+# `lax.axis_size(...)` APIs. Older jax (< 0.5) only ships
+# `jax.experimental.shard_map.shard_map` (same semantics under
+# `check_rep`) and exposes the bound axis size as `core.axis_frame(name)`
+# (an int; NameError when unbound — identical contract). Install
+# forwarding aliases so every module (and the tests) can use the one
+# spelling regardless of the installed jax. No-op on jax versions that
+# already export them.
+
+
+def _install_jax_compat() -> None:
+    import jax
+    from jax import lax
+
+    try:
+        jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as _experimental_sm
+
+        def shard_map(f, /, *, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=None, **kwargs):
+            if check_vma is not None:
+                kwargs["check_rep"] = check_vma
+            return _experimental_sm(f, mesh=mesh, in_specs=in_specs,
+                                    out_specs=out_specs, **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(lax, "axis_size"):
+        from jax import core as _core
+
+        def axis_size(axis_name):
+            return _core.axis_frame(axis_name)
+
+        lax.axis_size = axis_size
+
+
+_install_jax_compat()

@@ -39,11 +39,19 @@ from gtopkssgd_tpu.parallel import (
     make_mesh,
     sparse_allreduce,
 )
+from gtopkssgd_tpu.obs import Tracer
 from gtopkssgd_tpu.utils import (
+    safe_donate,
     sync_round_trip_seconds,
     timed_window,
     true_sync,
 )
+
+# Module-level tracer: every measured window runs inside a named span, so a
+# jax.profiler capture of a bench run (e.g. under benchmarks/profile_step)
+# shows which phase each device region belongs to. No metrics sink — the
+# bench emits its own JSON artifacts; the spans are for trace correlation.
+_TRACER = Tracer()
 
 
 @dataclasses.dataclass
@@ -233,7 +241,7 @@ def measure_throughput(cfg: BenchConfig, mode: Optional[str],
             step, mesh=mesh, in_specs=(state_spec, P("dp")),
             out_specs=(state_spec, P()), check_vma=False,
         ),
-        donate_argnums=(0,),
+        donate_argnums=safe_donate(0),
     )
     opt0 = expand_residual_per_device(jax.jit(tx.init)(params), p, mesh)
     state = (params, bs, opt0)
@@ -241,8 +249,9 @@ def measure_throughput(cfg: BenchConfig, mode: Optional[str],
 
     compiled = fn.lower(state, batch).compile()
     flops_per_step = _compiled_flops(compiled)
-    sec, steps, _ = time_compiled_step(compiled, state, batch,
-                                       cfg.min_seconds)
+    with _TRACER.span("bench/throughput", mode=mode or "dense"):
+        sec, steps, _ = time_compiled_step(compiled, state, batch,
+                                           cfg.min_seconds)
 
     from gtopkssgd_tpu.optimizer import wire_k
 
@@ -395,17 +404,20 @@ def measure_breakdown(cfg: BenchConfig, mode: Optional[str],
     res: Dict[str, float] = {"mode": mode or "dense", "density": density}
     jf = jax.jit(fwd_bwd)
     flat = jf(params)
-    res["forward_backward"] = _timeit(jf, (params,), cfg.steps)
+    with _TRACER.span("bench/forward_backward"):
+        res["forward_backward"] = _timeit(jf, (params,), cfg.steps)
     if dense_mode:
         flats = jnp.broadcast_to(flat, (p,) + flat.shape)
         res["compress"] = 0.0
-        res["comm"] = _timeit(comm_dense, (flats,), cfg.steps)
+        with _TRACER.span("bench/comm"):
+            res["comm"] = _timeit(comm_dense, (flats,), cfg.steps)
         dense_grad = flat
     else:
         residual = compressor.init_residual(n)
         jc = jax.jit(compress)
         vals, idx, _ = jc(flat, residual)
-        res["compress"] = _timeit(jc, (flat, residual), cfg.steps)
+        with _TRACER.span("bench/compress"):
+            res["compress"] = _timeit(jc, (flat, residual), cfg.steps)
         valss, idxs = _distinct_sparse_sets(vals, idx, p, n)
         if hier_ici > 1:
             # Pre-shard the per-device flats over 'dp' so the timed window
@@ -416,13 +428,16 @@ def measure_breakdown(cfg: BenchConfig, mode: Optional[str],
                 jnp.broadcast_to(flat, (p,) + flat.shape),
                 NamedSharding(mesh, P("dp")),
             )
-            res["comm"] = _timeit(
-                comm_gtopk, (flats, valss, idxs), cfg.steps)
+            with _TRACER.span("bench/comm"):
+                res["comm"] = _timeit(
+                    comm_gtopk, (flats, valss, idxs), cfg.steps)
         else:
-            res["comm"] = _timeit(comm_gtopk, (valss, idxs), cfg.steps)
+            with _TRACER.span("bench/comm"):
+                res["comm"] = _timeit(comm_gtopk, (valss, idxs), cfg.steps)
         dense_grad = scatter_add_dense(n, idx, vals)
     ja = jax.jit(apply_updates)
-    res["apply"] = _timeit(ja, (params, dense_grad), cfg.steps)
+    with _TRACER.span("bench/apply"):
+        res["apply"] = _timeit(ja, (params, dense_grad), cfg.steps)
     res["sum"] = sum(v for q, v in res.items()
                      if q in ("forward_backward", "compress", "comm", "apply"))
     return res
@@ -502,16 +517,20 @@ def _measure_breakdown_layerwise(cfg: BenchConfig, mode: str,
                              "k_total": kk_total, "n": n}
     jf = jax.jit(fwd_bwd)
     grads = jf(params)
-    res["forward_backward"] = _timeit(jf, (params,), cfg.steps)
+    with _TRACER.span("bench/forward_backward"):
+        res["forward_backward"] = _timeit(jf, (params,), cfg.steps)
     residual = tuple(jnp.zeros((s,), jnp.float32) for s in sizes)
     jc = jax.jit(compress_per_leaf)
     vals, idx, _ = jc(grads, residual)
-    res["compress_per_leaf"] = _timeit(jc, (grads, residual), cfg.steps)
+    with _TRACER.span("bench/compress_per_leaf"):
+        res["compress_per_leaf"] = _timeit(jc, (grads, residual), cfg.steps)
     valss, idxs = _distinct_sparse_sets(vals, idx, p, n)
-    res["comm"] = _timeit(comm, (valss, idxs), cfg.steps)
+    with _TRACER.span("bench/comm"):
+        res["comm"] = _timeit(comm, (valss, idxs), cfg.steps)
     gvals, gidx = comm(valss, idxs)
     ja = jax.jit(apply_updates)
-    res["apply"] = _timeit(ja, (params, gvals[0], gidx[0]), cfg.steps)
+    with _TRACER.span("bench/apply"):
+        res["apply"] = _timeit(ja, (params, gvals[0], gidx[0]), cfg.steps)
     res["sum"] = sum(v for q, v in res.items()
                      if q in ("forward_backward", "compress_per_leaf",
                               "comm", "apply"))

@@ -16,6 +16,24 @@ Flags keep the reference's names (--dnn, --dataset, --density,
 becomes
     python -m gtopkssgd_tpu.dist_trainer --dnn resnet20 --density 0.001 \
         --nworkers 8
+
+Observability flags (obs subsystem — no reference equivalent; the
+reference's only telemetry was text logs):
+
+    --obs-counters / --no-obs-counters   on-device compression counters
+                                         (achieved density, tau, grad/
+                                         residual norms, wire bytes) as
+                                         per-step "obs" records (default on)
+    --obs-interval N                     log "obs" every N steps (reading
+                                         counters syncs on the step; raise
+                                         to preserve dispatch overlap)
+    --obs-watchdog SECONDS               dispatch stall watchdog: fail fast
+                                         with a structured diagnostic (exit
+                                         43) instead of hanging forever on
+                                         a dead accelerator tunnel (0 = off)
+
+Summarize or diff the resulting metrics.jsonl with
+``python -m gtopkssgd_tpu.obs.report <out-dir> [<other-out-dir>]``.
 """
 
 from __future__ import annotations
@@ -106,6 +124,20 @@ def build_argparser() -> argparse.ArgumentParser:
                         "(reference DataLoader num_workers; ~280 img/s per "
                         "core vs ~6.8k img/s per v5e chip at bs=128 — see "
                         "benchmarks/results/input_path_1core_host.json)")
+    p.add_argument("--obs-counters", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="on-device compression/comm counters logged as "
+                        "per-step 'obs' records (--no-obs-counters traces "
+                        "the step exactly as before the obs subsystem)")
+    p.add_argument("--obs-interval", type=int, default=1,
+                   help="log an 'obs' record every N optimizer steps; "
+                        "reading counters syncs on the dispatched step, "
+                        "so raise this to keep async dispatch overlap")
+    p.add_argument("--obs-watchdog", type=float, default=0.0,
+                   help="seconds a dispatched step may go without host-"
+                        "visible progress before the stall watchdog dumps "
+                        "a structured diagnostic and exits 43 (0 = off); "
+                        "set well above log-interval * step time")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint from out-dir")
     p.add_argument("--multihost", action="store_true",
@@ -147,6 +179,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         synth_hard=args.synth_hard,
         eval_batches=args.eval_batches,
         log_interval=args.log_interval,
+        obs_counters=args.obs_counters,
+        obs_interval=args.obs_interval,
+        obs_watchdog=args.obs_watchdog,
         prefetch=args.prefetch,
         decode_workers=args.decode_workers,
     )

@@ -1,0 +1,49 @@
+"""Unified observability subsystem (the paper's measured decomposition,
+made first-class).
+
+The paper's entire argument is a measured decomposition — compute vs.
+selection vs. communication time and the sparsity achieved on the wire
+(arXiv:1901.04359; arXiv:1911.08772 ties convergence to the error-feedback
+residual dynamics). This package turns the repo's scattered primitives
+(host timers, a bare jsonl logger, a --profile-dir flag) into one layer:
+
+  counters.py — on-device training-health counters computed INSIDE the
+      jitted step (achieved density, top-k threshold tau, pre/post
+      compression gradient norms, error-feedback residual norm, wire
+      bytes) and carried out through the optimizer state, so compression
+      quality is a per-step metric for every mode.
+  tracing.py  — span API emitting BOTH host-side records (metrics.jsonl /
+      TimingStats) and jax.profiler.TraceAnnotation scopes, so device
+      traces and host timelines correlate on the same names.
+  watchdog.py — dispatch stall watchdog: a monitor thread that detects a
+      dispatched step failing to become ready within a deadline (the
+      BENCH_r05 dead-tunnel mode), emits a structured diagnostic and
+      fails fast instead of hanging.
+  report.py   — ``python -m gtopkssgd_tpu.obs.report`` aggregates one or
+      two metrics.jsonl runs into per-kind/per-metric summaries and a
+      side-by-side regression-triage comparison.
+"""
+
+from gtopkssgd_tpu.obs.counters import (
+    TELEMETRY_FIELDS,
+    keep_tau,
+    make_telemetry,
+    selected_tau,
+    sent_count,
+    tree_l2,
+    zero_telemetry,
+)
+from gtopkssgd_tpu.obs.tracing import Tracer
+from gtopkssgd_tpu.obs.watchdog import StallWatchdog
+
+__all__ = [
+    "TELEMETRY_FIELDS",
+    "Tracer",
+    "StallWatchdog",
+    "keep_tau",
+    "make_telemetry",
+    "selected_tau",
+    "sent_count",
+    "tree_l2",
+    "zero_telemetry",
+]

@@ -1,0 +1,136 @@
+"""On-device training-health counters for the compression pipeline.
+
+Everything here is traced INSIDE the jitted train step (pure jnp on
+device-resident arrays — no host round-trips) and carried out through the
+optimizer state's ``telemetry`` field, so the per-step numbers ride the
+existing metrics path for every mode (gtopk, gtopk_layerwise, gtopk_hier,
+allgather, dense).
+
+The counter set is the paper's own analysis axis plus the residual
+dynamics arXiv:1911.08772 shows convergence hinges on:
+
+  grad_norm_pre    — L2 of the local gradient entering the pipeline
+                     (post-clip, post ICI slice-sum in hier mode)
+  grad_norm_post   — L2 of the averaged dense update actually applied
+  residual_norm    — L2 of the error-feedback residual AFTER repair (the
+                     v buffer under momentum correction)
+  tau              — the top-k selection threshold: smallest selected
+                     magnitude (0 in dense phases/modes)
+  sent_elems       — actual NONZERO elements in the communicated set
+                     (padding slots in a <k-nonzero step don't count)
+  achieved_density — sent_elems / N vs. the requested rho
+  wire_bytes       — the comm-volume model for this step's collective
+                     (parallel.comm_bytes_per_step — O(k log P) gtopk,
+                     O(k P) allgather, O(N) dense), a static per-step
+                     constant that makes jsonl rows self-describing
+
+All values are f32 scalars; under shard_map the optimizer pmeans them over
+the dp axis so the stored telemetry is replicated (per-device quantities
+like the residual norm become axis means, which is the number you want on
+a dashboard anyway).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from gtopkssgd_tpu.parallel import comm_bytes_per_step
+
+Array = jax.Array
+
+TELEMETRY_FIELDS = (
+    "grad_norm_pre",
+    "grad_norm_post",
+    "residual_norm",
+    "tau",
+    "sent_elems",
+    "achieved_density",
+    "wire_bytes",
+)
+
+
+def zero_telemetry() -> Dict[str, Array]:
+    """The fixed telemetry structure at init (all zeros). init_fn uses this
+    so the state pytree has an identical treedef at step 0 and step k."""
+    return {f: jnp.zeros((), jnp.float32) for f in TELEMETRY_FIELDS}
+
+
+def tree_l2(tree) -> Array:
+    """L2 norm over every leaf of a pytree (flat arrays, per-leaf tuples,
+    or a single array alike). Empty trees / zero-size leaves give 0."""
+    leaves = [l for l in jax.tree.leaves(tree) if hasattr(l, "size")]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    total = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(total)
+
+
+def residual_l2(residual) -> Array:
+    """L2 of the error-feedback buffer. Under momentum correction the
+    residual field is ``{"v": ..., "u": ...}``; v is the accumulated-
+    velocity buffer that plays the residual's role (optimizer.py), so the
+    norm reads v only — including u would double-count momentum mass."""
+    if isinstance(residual, dict) and "v" in residual:
+        residual = residual["v"]
+    return tree_l2(residual)
+
+
+def selected_tau(vals: Array) -> Array:
+    """Top-k threshold from a selected-values buffer: the smallest NONZERO
+    selected magnitude. Selection kernels pad value slots with 0.0 when
+    fewer than k nonzeros exist; a plain min would report tau=0 for every
+    such step and hide the real threshold."""
+    mags = jnp.abs(vals)
+    nz = mags > 0
+    t = jnp.min(jnp.where(nz, mags, jnp.inf))
+    return jnp.where(jnp.any(nz), t, 0.0).astype(jnp.float32)
+
+
+def keep_tau(keep: Array, acc: Array) -> Array:
+    """tau for the mask-form selection (compress_by_threshold): smallest
+    kept magnitude, 0 when nothing is kept."""
+    mags = jnp.abs(acc)
+    t = jnp.min(jnp.where(keep, mags, jnp.inf))
+    return jnp.where(jnp.any(keep), t, 0.0).astype(jnp.float32)
+
+
+def sent_count(vals: Array) -> Array:
+    """Actual nonzeros in a communicated value buffer (f32 scalar)."""
+    return jnp.sum((vals != 0).astype(jnp.float32))
+
+
+def make_telemetry(
+    *,
+    n: int,
+    k: int,
+    p: int,
+    mode,
+    ici_size: int = 1,
+    grad_norm_pre,
+    grad_norm_post,
+    residual_norm,
+    tau,
+    sent_elems,
+) -> Dict[str, Array]:
+    """Assemble the per-step telemetry dict (all f32 scalars).
+
+    ``n``/``k``/``p``/``mode``/``ici_size`` are static trace-time values;
+    ``wire_bytes`` therefore folds to a constant — the model volume for
+    this step's collective from the one shared definition
+    (parallel.comm_bytes_per_step), so the metric can never drift from
+    the benchmark's comm model."""
+    sent = jnp.asarray(sent_elems, jnp.float32)
+    return {
+        "grad_norm_pre": jnp.asarray(grad_norm_pre, jnp.float32),
+        "grad_norm_post": jnp.asarray(grad_norm_post, jnp.float32),
+        "residual_norm": jnp.asarray(residual_norm, jnp.float32),
+        "tau": jnp.asarray(tau, jnp.float32),
+        "sent_elems": sent,
+        "achieved_density": sent / jnp.float32(max(1, n)),
+        "wire_bytes": jnp.float32(
+            comm_bytes_per_step(mode, n, k, p, ici_size=ici_size)
+        ),
+    }

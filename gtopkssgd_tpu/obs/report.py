@@ -1,0 +1,220 @@
+"""Metrics report CLI: aggregate metrics.jsonl runs, compare two of them.
+
+    python -m gtopkssgd_tpu.obs.report <run>            # summarize one run
+    python -m gtopkssgd_tpu.obs.report <runA> <runB>    # side-by-side diff
+    python -m gtopkssgd_tpu.obs.report <run> --json out.json
+
+A <run> is a directory containing metrics.jsonl (what --out-dir produces)
+or a path to any .jsonl file of MetricsLogger records. Records group by
+their ``kind`` ("train", "eval", "obs", "spans", "epoch", ...); every
+numeric field gets count/mean/min/max/last. The two-run mode prints mean
+vs. mean with a signed delta per field — the bench-regression triage view
+(was r05 slower because comm grew, or because achieved density drifted?).
+
+Malformed lines are counted and skipped, never fatal: a run killed by the
+stall watchdog (or the kernel) may leave a torn final line, and the whole
+point of the report is reading evidence out of exactly such runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Bookkeeping fields that are not measurements; excluded from aggregation.
+_META_FIELDS = {"kind", "time", "rank"}
+
+
+def resolve_path(run: str) -> str:
+    """<run dir> -> its metrics.jsonl; a file path passes through."""
+    if os.path.isdir(run):
+        return os.path.join(run, "metrics.jsonl")
+    return run
+
+
+def load_records(run: str) -> Tuple[List[dict], int]:
+    """Parse a run's records. Returns (records, n_malformed)."""
+    path = resolve_path(run)
+    records, bad = [], 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+            else:
+                bad += 1
+    return records, bad
+
+
+def summarize(records: Iterable[dict]) -> Dict[str, Dict[str, dict]]:
+    """{kind: {field: {count, mean, min, max, last}}} over numeric fields."""
+    acc: Dict[str, Dict[str, List[float]]] = {}
+    for rec in records:
+        kind = str(rec.get("kind", "?"))
+        fields = acc.setdefault(kind, {})
+        for key, val in rec.items():
+            if key in _META_FIELDS:
+                continue
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                continue
+            fields.setdefault(key, []).append(float(val))
+    out: Dict[str, Dict[str, dict]] = {}
+    for kind, fields in acc.items():
+        out[kind] = {}
+        for key, vals in fields.items():
+            out[kind][key] = {
+                "count": len(vals),
+                "mean": sum(vals) / len(vals),
+                "min": min(vals),
+                "max": max(vals),
+                "last": vals[-1],
+            }
+    return out
+
+
+def _fmt(v: float) -> str:
+    if v != v:  # NaN
+        return "nan"
+    a = abs(v)
+    if (a != 0 and a < 1e-3) or a >= 1e7:
+        return f"{v:.4g}"
+    if a >= 100 or v == int(v):
+        return f"{v:.6g}"
+    return f"{v:.4f}"
+
+
+def _table(rows: List[Sequence[str]], header: Sequence[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows)
+        for i in range(len(header))
+    ]
+    lines = []
+    for r in [header, ["-" * w for w in widths]] + rows:
+        lines.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
+def format_summary(name: str, summary: Dict[str, Dict[str, dict]],
+                   kinds: Optional[Sequence[str]] = None) -> str:
+    chunks = [f"run: {name}"]
+    for kind in sorted(summary):
+        if kinds and kind not in kinds:
+            continue
+        fields = summary[kind]
+        if not fields:
+            continue
+        n = max(s["count"] for s in fields.values())
+        chunks.append(f"\n[{kind}] ({n} records)")
+        rows = [
+            [key, str(s["count"]), _fmt(s["mean"]), _fmt(s["min"]),
+             _fmt(s["max"]), _fmt(s["last"])]
+            for key, s in sorted(fields.items())
+        ]
+        chunks.append(
+            _table(rows, ["field", "count", "mean", "min", "max", "last"]))
+    return "\n".join(chunks)
+
+
+def compare(a: Dict[str, Dict[str, dict]],
+            b: Dict[str, Dict[str, dict]]) -> Dict[str, Dict[str, dict]]:
+    """Per-kind/field mean-vs-mean diff for every field both runs have."""
+    out: Dict[str, Dict[str, dict]] = {}
+    for kind in sorted(set(a) & set(b)):
+        fields = sorted(set(a[kind]) & set(b[kind]))
+        if not fields:
+            continue
+        out[kind] = {}
+        for key in fields:
+            ma, mb = a[kind][key]["mean"], b[kind][key]["mean"]
+            delta = mb - ma
+            pct = (delta / abs(ma) * 100.0) if ma else float("nan")
+            out[kind][key] = {"mean_a": ma, "mean_b": mb,
+                              "delta": delta, "delta_pct": pct}
+    return out
+
+
+def format_compare(name_a: str, name_b: str,
+                   diff: Dict[str, Dict[str, dict]],
+                   kinds: Optional[Sequence[str]] = None) -> str:
+    chunks = [f"compare: A={name_a}  B={name_b}"]
+    for kind in sorted(diff):
+        if kinds and kind not in kinds:
+            continue
+        rows = []
+        for key, d in sorted(diff[kind].items()):
+            pct = d["delta_pct"]
+            rows.append([
+                key, _fmt(d["mean_a"]), _fmt(d["mean_b"]), _fmt(d["delta"]),
+                ("nan" if pct != pct else f"{pct:+.1f}%"),
+            ])
+        if rows:
+            chunks.append(f"\n[{kind}]")
+            chunks.append(_table(
+                rows, ["field", "mean_A", "mean_B", "delta", "delta%"]))
+    return "\n".join(chunks)
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "gtopkssgd_tpu.obs.report",
+        description="Aggregate metrics.jsonl runs; compare two for "
+                    "regression triage.",
+    )
+    p.add_argument("runs", nargs="+",
+                   help="1 or 2 runs: an --out-dir (containing "
+                        "metrics.jsonl) or a .jsonl path")
+    p.add_argument("--kinds", default=None,
+                   help="comma-separated record kinds to report "
+                        "(default: all present)")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="also write the aggregate (or diff) as JSON here")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_argparser().parse_args(argv)
+    if len(args.runs) > 2:
+        print("at most 2 runs (one to summarize, two to compare)")
+        return 2
+    kinds = ([k.strip() for k in args.kinds.split(",") if k.strip()]
+             if args.kinds else None)
+    summaries, names = [], []
+    for run in args.runs:
+        try:
+            records, bad = load_records(run)
+        except OSError as e:
+            print(f"cannot read {run}: {e}")
+            return 2
+        names.append(os.path.basename(os.path.normpath(run)) or run)
+        summaries.append(summarize(records))
+        if bad:
+            print(f"note: {run}: skipped {bad} malformed line(s)")
+    if len(summaries) == 1:
+        payload = {"run": names[0], "summary": summaries[0]}
+        print(format_summary(names[0], summaries[0], kinds))
+    else:
+        diff = compare(summaries[0], summaries[1])
+        payload = {
+            "run_a": names[0], "run_b": names[1],
+            "summary_a": summaries[0], "summary_b": summaries[1],
+            "diff": diff,
+        }
+        print(format_compare(names[0], names[1], diff, kinds))
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=True)
+        print(f"\nwrote {args.json_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

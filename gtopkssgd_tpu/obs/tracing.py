@@ -1,0 +1,125 @@
+"""Tracing spans: one name, two timelines.
+
+A ``Tracer.span("io")`` emits
+
+  * a host-side duration into a TimingStats accumulator (and optionally a
+    per-span metrics.jsonl record), and
+  * a ``jax.profiler.TraceAnnotation`` scope with the same (nested) path,
+
+so a phase in the host timeline and the same phase in a device trace
+captured via ``--profile-dir`` carry identical names and can be lined up.
+This replaces the ad-hoc StepTimer call sites in trainer.py/benchmark.py
+(utils/timers.py keeps StepTimer for the sync/timing primitives the
+benchmark harness builds on; the span API is the instrumentation layer).
+
+Spans nest: ``span("train")`` containing ``span("io")`` accumulates under
+the path ``"train/io"``. Nesting is tracked per-thread, so the prefetch
+worker's spans cannot interleave into the consumer thread's path.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+import jax
+
+from gtopkssgd_tpu.utils.timers import TimingStats
+
+
+class Tracer:
+    def __init__(
+        self,
+        stats: Optional[TimingStats] = None,
+        metrics=None,
+        enabled: bool = True,
+        record_each: bool = False,
+    ):
+        """``metrics`` is a utils.metrics.MetricsLogger (or anything with
+        ``.log(kind, **fields)``). ``record_each=True`` writes one jsonl
+        record per span close — verbose; the default accumulates into
+        ``stats`` and ships means via ``flush()``."""
+        self.stats = stats or TimingStats()
+        self.metrics = metrics
+        self.enabled = enabled
+        self.record_each = record_each
+        self._local = threading.local()
+
+    def _stack(self):
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @property
+    def current_path(self) -> str:
+        return "/".join(self._stack())
+
+    @contextmanager
+    def span(self, name: str, *, sync: bool = False, value=None, **attrs):
+        """Time a scope under ``name`` (nested under any open spans).
+
+        ``sync=True`` blocks on JAX's async queue before stopping the
+        clock (``value`` fences just that output) — same semantics as the
+        StepTimer this API replaces; leave False for host-only phases
+        like data loading, and for dispatch phases where the async queue
+        must NOT be drained (the whole point of overlap)."""
+        if not self.enabled:
+            yield
+            return
+        stack = self._stack()
+        stack.append(name)
+        path = "/".join(stack)
+        ann = jax.profiler.TraceAnnotation(path)
+        t0 = time.perf_counter()
+        ann.__enter__()
+        try:
+            yield
+        finally:
+            try:
+                if sync:
+                    if value is not None:
+                        jax.block_until_ready(value)
+                    else:
+                        jax.effects_barrier()
+            finally:
+                ann.__exit__(None, None, None)
+                dur = time.perf_counter() - t0
+                stack.pop()
+                self.stats.add(path, dur)
+                if self.record_each and self.metrics is not None:
+                    self.metrics.log(
+                        "span", name=name, path=path, dur_s=dur, **attrs
+                    )
+
+    def annotate(self, name: Optional[str] = None):
+        """Decorator form (the jax.profiler.annotate_function idiom):
+        every call of the wrapped function runs inside a span."""
+
+        def deco(fn):
+            label = name or fn.__name__
+
+            @functools.wraps(fn)
+            def wrapped(*args, **kwargs):
+                with self.span(label):
+                    return fn(*args, **kwargs)
+
+            return wrapped
+
+        return deco
+
+    def flush(self, step: Optional[int] = None) -> Dict[str, float]:
+        """Ship accumulated per-path mean seconds as ONE 'spans' record
+        and reset, so each logging window reports its own means (the
+        reference logged its timer dicts every N iterations the same
+        way). Returns the summary that was logged."""
+        summary = self.stats.summary()
+        if summary and self.metrics is not None:
+            rec = {} if step is None else {"step": step}
+            rec.update({path: round(sec, 6) for path, sec in summary.items()})
+            self.metrics.log("spans", **rec)
+        self.stats.reset()
+        return summary

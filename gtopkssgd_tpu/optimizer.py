@@ -34,7 +34,7 @@ Pipeline inside ``update`` (names match the reference call stack, SURVEY.md
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Optional, Union
+from typing import Any, Callable, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +43,7 @@ from jax import lax
 from jax.flatten_util import ravel_pytree
 
 from gtopkssgd_tpu.compression import get_compressor
+from gtopkssgd_tpu.obs import counters as obs_counters
 from gtopkssgd_tpu.modes import (
     ALL_MODES,
     DENSE_MODES,
@@ -72,11 +73,19 @@ class GTopKSGDState(NamedTuple):
     the local momentum buffer (same flat/per-leaf shape as v). Every
     consumer (trainer shard_map strip/restore, per-device expansion, the
     checkpoint template) tree-maps over the field, so all three layouts
-    ride the same plumbing."""
+    ride the same plumbing.
+
+    ``telemetry`` (obs subsystem, default off -> an empty pytree) carries
+    the on-device training-health counters of the step that PRODUCED this
+    state (obs.counters: achieved density, tau, residual norm, grad
+    norms, wire bytes) — f32 scalars, replicated under shard_map (the
+    optimizer pmeans them), so the host can read them without touching
+    per-device state."""
 
     count: Array
     residual: Array
     inner: optax.OptState
+    telemetry: Any = ()
 
 
 def gtopk_sgd(
@@ -94,6 +103,7 @@ def gtopk_sgd(
     hier_ici_size: int = 1,
     warmup_dense_steps: int = 0,
     momentum_correction: bool = False,
+    telemetry: bool = False,
     _restore_rejected_u: bool = False,
 ) -> optax.GradientTransformation:
     """Build the distributed gTop-k S-SGD gradient transformation.
@@ -180,6 +190,15 @@ def gtopk_sgd(
     weight_decay=0; with weight decay the two differ in whether the
     wd·params term passes through the momentum trace (dense baseline)
     or is added un-momentum'd after the collective (correction).
+
+    ``telemetry`` (obs subsystem) computes the on-device training-health
+    counters (obs.counters.TELEMETRY_FIELDS: achieved wire density, top-k
+    threshold tau, pre/post-compression gradient norms, error-feedback
+    residual norm, modeled wire bytes) inside the jitted update and
+    stores them in ``state.telemetry`` — a handful of scalar reductions,
+    fused into ops the step already runs; under a bound mesh axis they
+    are pmean'd so the stored values are replicated. Off by default: the
+    disabled path traces bit-identically to before the flag existed.
     """
     mode = compression
     if mode not in ALL_MODES:
@@ -288,7 +307,17 @@ def gtopk_sgd(
             count=jnp.zeros((), jnp.int32),
             residual=residual,
             inner=inner.init(params),
+            telemetry=obs_counters.zero_telemetry() if telemetry else (),
         )
+
+    def _finish_telemetry(tel, p):
+        """pmean the per-device scalars when a mesh axis is bound so the
+        stored telemetry is replicated (out_specs P() in the trainer);
+        per-device quantities (residual norm, sent count) become axis
+        means — the aggregate a dashboard wants anyway."""
+        if p > 1:
+            tel = {key: lax.pmean(v, axis_name) for key, v in tel.items()}
+        return tel
 
     def layerwise_update(grads, state: GTopKSGDState, params=None):
         """Per-layer select/feedback; global reduce on the concatenated set.
@@ -328,6 +357,7 @@ def gtopk_sgd(
 
         def sparse_branch(srcs, res_in, us):
             accs = [s + r for s, r in zip(srcs, res_in)]
+            tel = ()
             if p == 1:
                 # Threshold form of the per-leaf selection (see the flat
                 # path's p=1 branch and compress_by_threshold's
@@ -344,8 +374,18 @@ def gtopk_sgd(
                 u_out = (tuple(jnp.where(m, 0.0, u)
                                for u, m in zip(us, keeps))
                          if correction else us)
-                return ([a - r for a, r in zip(accs, new_res)],
-                        tuple(new_res), u_out)
+                if telemetry:
+                    taus = jnp.stack([
+                        obs_counters.keep_tau(m, a)
+                        for m, a in zip(keeps, accs)])
+                    any_kept = jnp.stack([jnp.any(m) for m in keeps])
+                    tel = (jnp.where(jnp.any(any_kept),
+                                     jnp.min(jnp.where(any_kept, taus,
+                                                       jnp.inf)), 0.0),
+                           sum(jnp.sum(m.astype(jnp.float32))
+                               for m in keeps))
+                return (([a - r for a, r in zip(accs, new_res)],
+                         tuple(new_res), u_out) + tel)
             sel = [select_topk(a, kl, topk_method)
                    for a, kl in zip(accs, ks)]
             idx_l = [i for _, i in sel]
@@ -394,20 +434,31 @@ def gtopk_sgd(
                 u_out = tuple(restored)
             dense = scatter_add_dense(n, gidx, gvals) / p
             dense_fl = [dense[o:o + s] for o, s in zip(offsets, sizes)]
-            return dense_fl, tuple(repaired), u_out
+            if telemetry:
+                tel = (obs_counters.selected_tau(vals),
+                       obs_counters.sent_count(vals))
+            return (dense_fl, tuple(repaired), u_out) + tel
 
         if warmup_dense_steps > 0:
             def dense_branch(srcs, res_in, us):
                 if p > 1:
                     srcs = [lax.psum(s, axis_name) / p for s in srcs]
-                return srcs, res_in, us
+                # dense phase telemetry: no threshold, everything sent
+                tel = ((jnp.float32(0.0), jnp.float32(n))
+                       if telemetry else ())
+                return (srcs, res_in, us) + tel
 
-            dense_fl, residual, u_new = lax.cond(
+            out = lax.cond(
                 state.count < warmup_dense_steps,
                 dense_branch, sparse_branch, srcs, res_in, us,
             )
         else:
-            dense_fl, residual, u_new = sparse_branch(srcs, res_in, us)
+            out = sparse_branch(srcs, res_in, us)
+        if telemetry:
+            dense_fl, residual, u_new, tau, sent = out
+        else:
+            dense_fl, residual, u_new = out
+        res_struct = residual
         if correction:
             residual = {"v": residual, "u": u_new}
 
@@ -415,8 +466,20 @@ def gtopk_sgd(
             d.reshape(leaf.shape) for d, leaf in zip(dense_fl, leaves)
         ])
         updates, inner_state = inner.update(avg_grads, state.inner, params)
+        if telemetry:
+            tel = obs_counters.make_telemetry(
+                n=n, k=kk_total, p=p, mode=mode,
+                grad_norm_pre=obs_counters.tree_l2(flats),
+                grad_norm_post=obs_counters.tree_l2(dense_fl),
+                residual_norm=obs_counters.tree_l2(res_struct),
+                tau=tau, sent_elems=sent,
+            )
+            tel = _finish_telemetry(tel, p)
+        else:
+            tel = state.telemetry
         new_state = GTopKSGDState(
-            count=state.count + 1, residual=residual, inner=inner_state
+            count=state.count + 1, residual=residual, inner=inner_state,
+            telemetry=tel,
         )
         return updates, new_state
 
@@ -446,10 +509,14 @@ def gtopk_sgd(
                 flat, axis_name=axis_name, axis_size=p,
                 ici_size=hier_ici_size,
             )
+        tau = sent = None
         if dense_mode:
             reduced = lax.psum(flat, axis_name) if p > 1 else flat
             dense = reduced / p
             residual = state.residual
+            res_struct = residual
+            if telemetry:
+                tau, sent = jnp.float32(0.0), jnp.float32(n)
         else:
             if correction:
                 # DGC velocity recursion on the LOCAL (or slice-summed, in
@@ -464,6 +531,7 @@ def gtopk_sgd(
 
             def sparse_branch(src, residual_in, u_in):
                 acc = compressor.accumulate(src, residual_in)
+                tel = ()
                 if p == 1:
                     # No collective at p=1, so nothing ever needs the
                     # (vals, idx) wire format — select by THRESHOLD
@@ -483,8 +551,14 @@ def gtopk_sgd(
                     dense = acc - residual
                     u_out = (jnp.where(keep, 0.0, u_in)
                              if correction else u_in)
+                    if telemetry:
+                        tel = (obs_counters.keep_tau(keep, acc),
+                               jnp.sum(keep.astype(jnp.float32)))
                 else:
                     vals, idx, residual = compressor.compress(acc)
+                    if telemetry:
+                        tel = (obs_counters.selected_tau(vals),
+                               obs_counters.sent_count(vals))
                     # Momentum factor masking: a DELIVERED coordinate's
                     # velocity restarts (its momentum was consumed);
                     # without this the same mass re-sends for ~1/momentum
@@ -522,7 +596,7 @@ def gtopk_sgd(
                                 mode="drop")
                     else:  # allgather union: dense, every pick lands
                         dense = result / p
-                return dense, residual, u_out
+                return (dense, residual, u_out) + tel
 
             if warmup_dense_steps > 0:
                 def dense_branch(src, residual_in, u_in):
@@ -536,21 +610,42 @@ def gtopk_sgd(
                     # gradient (mean is linear in u), and u is NOT masked
                     # (nothing was transmitted sparsely).
                     scale = p * (hier_ici_size if (hier and p > 1) else 1)
-                    return reduced / scale, residual_in, u_in
+                    # dense phase telemetry: no threshold, everything sent
+                    tel = ((jnp.float32(0.0), jnp.float32(n))
+                           if telemetry else ())
+                    return (reduced / scale, residual_in, u_in) + tel
 
-                dense, residual, u_new = lax.cond(
+                out = lax.cond(
                     state.count < warmup_dense_steps,
                     dense_branch, sparse_branch, src, res_in, u,
                 )
             else:
-                dense, residual, u_new = sparse_branch(src, res_in, u)
+                out = sparse_branch(src, res_in, u)
+            if telemetry:
+                dense, residual, u_new, tau, sent = out
+            else:
+                dense, residual, u_new = out
+            res_struct = residual
             if correction:
                 residual = {"v": residual, "u": u_new}
 
         avg_grads = unravel(dense)
         updates, inner_state = inner.update(avg_grads, state.inner, params)
+        if telemetry:
+            tel = obs_counters.make_telemetry(
+                n=n, k=(n if dense_mode else compressor.k(n)), p=p,
+                mode=mode, ici_size=hier_ici_size if hier else 1,
+                grad_norm_pre=obs_counters.tree_l2(flat),
+                grad_norm_post=obs_counters.tree_l2(dense),
+                residual_norm=obs_counters.tree_l2(res_struct),
+                tau=tau, sent_elems=sent,
+            )
+            tel = _finish_telemetry(tel, p)
+        else:
+            tel = state.telemetry
         new_state = GTopKSGDState(
-            count=state.count + 1, residual=residual, inner=inner_state
+            count=state.count + 1, residual=residual, inner=inner_state,
+            telemetry=tel,
         )
         return updates, new_state
 

@@ -17,6 +17,7 @@ from gtopkssgd_tpu.utils.settings import (
     force_cpu_mesh,
     get_logger,
     init_backend_with_deadline,
+    safe_donate,
 )
 from gtopkssgd_tpu.utils.prefetch import Prefetcher
 
@@ -32,5 +33,6 @@ __all__ = [
     "enable_compilation_cache",
     "force_cpu_mesh",
     "init_backend_with_deadline",
+    "safe_donate",
     "Prefetcher",
 ]

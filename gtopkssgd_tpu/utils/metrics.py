@@ -4,6 +4,13 @@ The reference's de-facto metrics pipeline was parsing per-rank text logs
 (SURVEY.md §5). Here every record is appended as one JSON line to
 ``metrics.jsonl`` AND logged as the familiar human-readable line, so both
 machine analysis and eyeballs work.
+
+``MetricsLogger`` is a context manager; owners that cannot use ``with``
+(the Trainer holds one for its whole lifetime) call ``close()`` from their
+own ``__exit__``. The file is opened line-buffered, so every completed
+record hits the OS on its own ``write`` — a run killed mid-step (the stall
+watchdog hard-exits, the kernel OOM-kills) loses at most the line being
+written, without paying an explicit ``flush()`` syscall per record.
 """
 
 from __future__ import annotations
@@ -23,13 +30,13 @@ class MetricsLogger:
         self._fh = None
         if out_dir is not None and rank == 0:
             os.makedirs(out_dir, exist_ok=True)
-            self._fh = open(os.path.join(out_dir, "metrics.jsonl"), "a")
+            self._fh = open(os.path.join(out_dir, "metrics.jsonl"), "a",
+                            buffering=1)
 
     def log(self, kind: str, **fields: Any) -> Dict[str, Any]:
         rec = {"kind": kind, "time": time.time(), "rank": self.rank, **fields}
         if self._fh is not None:
             self._fh.write(json.dumps(rec) + "\n")
-            self._fh.flush()
         if self.logger is not None:
             human = " ".join(
                 f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
@@ -42,3 +49,9 @@ class MetricsLogger:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

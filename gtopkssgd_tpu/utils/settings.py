@@ -64,6 +64,23 @@ def enable_compilation_cache(
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+
+def safe_donate(*argnums: int) -> tuple:
+    """donate_argnums, except on XLA:CPU where it must be empty.
+
+    Executing a persistent-cache-DESERIALIZED executable whose signature
+    donates input buffers segfaults on XLA:CPU (jaxlib 0.4.x; reproduced
+    with the gtopk train step — cold compile runs fine, the warm-cache
+    run of the byte-identical program crashes at dispatch). Donation is
+    purely a device-memory optimization, so dropping it on the virtual
+    CPU mesh changes nothing observable; on TPU it stays, where the
+    param+optimizer aliasing actually pays.
+    """
+    import jax
+
+    return argnums if jax.default_backend() != "cpu" else ()
+
+
 def init_backend_with_deadline(timeout_s: float = 150.0) -> bool:
     """Initialize THIS process's jax backend, but give up after a deadline.
 

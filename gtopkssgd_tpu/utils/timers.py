@@ -10,6 +10,11 @@ For phases fused inside one jitted step (the production path — XLA overlaps
 comm and compute, so a host-side timer *cannot* see them separately), use
 the benchmark harness's segmented mode which jits each phase apart; this
 timer then reports whole-step time under 'step'.
+
+Instrumentation call sites (trainer/benchmark phase timing) live in
+``gtopkssgd_tpu.obs.tracing.Tracer``, which builds on TimingStats and adds
+nested span paths plus ``jax.profiler.TraceAnnotation`` scopes; StepTimer
+stays as the minimal primitive for harness-internal timing.
 """
 
 from __future__ import annotations

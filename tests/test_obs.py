@@ -1,0 +1,423 @@
+"""Observability subsystem (gtopkssgd_tpu.obs): on-device counters,
+tracing spans, the stall watchdog, and the report CLI.
+
+Counter semantics are pinned on tiny models where the expected values are
+computable by hand; the watchdog is driven with a deliberately-stalled
+armed region (never a real wedged backend); the report CLI round-trips a
+synthetic metrics.jsonl.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gtopkssgd_tpu.obs import (
+    TELEMETRY_FIELDS,
+    StallWatchdog,
+    Tracer,
+    counters as obs_counters,
+)
+from gtopkssgd_tpu.obs import report as obs_report
+from gtopkssgd_tpu.optimizer import gtopk_sgd
+from gtopkssgd_tpu.ops import k_for_density
+from gtopkssgd_tpu.utils.metrics import MetricsLogger
+
+
+def _tiny_params():
+    return {
+        "w": jnp.arange(1, 101, dtype=jnp.float32).reshape(10, 10) / 100,
+        "b": jnp.ones((7,), jnp.float32),
+    }
+
+
+def _tiny_grads(params):
+    # strictly nonzero, globally distinct magnitudes -> top-k has no ties
+    # and the threshold path keeps exactly k elements
+    leaves, treedef = jax.tree.flatten(params)
+    total = sum(x.size for x in leaves)
+    flat = jnp.arange(1, total + 1, dtype=jnp.float32) * 1e-3
+    out, off = [], 0
+    for x in leaves:
+        out.append(flat[off:off + x.size].reshape(x.shape))
+        off += x.size
+    return jax.tree.unflatten(treedef, out)
+
+
+# ------------------------------------------------------------- counters
+
+def test_gtopk_counters_single_worker():
+    params = _tiny_params()
+    grads = _tiny_grads(params)
+    n = sum(x.size for x in jax.tree.leaves(params))
+    rho = 0.05
+    tx = gtopk_sgd(0.1, compression="gtopk", density=rho, axis_name=None,
+                   telemetry=True)
+    state = tx.init(params)
+    # init telemetry is the zero struct with the full field set
+    assert set(state.telemetry) == set(TELEMETRY_FIELDS)
+    _, state = jax.jit(tx.update)(grads, state, params)
+    tel = {k: float(v) for k, v in state.telemetry.items()}
+
+    k = k_for_density(n, rho)
+    # achieved density within one element of the requested rho
+    assert abs(tel["sent_elems"] - k) <= 1
+    assert abs(tel["achieved_density"] - k / n) <= 1.0 / n
+    assert tel["tau"] > 0
+    assert tel["residual_norm"] > 0          # error feedback accumulated
+    assert tel["grad_norm_pre"] > 0
+    assert 0 < tel["grad_norm_post"] < tel["grad_norm_pre"]
+    assert tel["wire_bytes"] == 8 * k        # p=1: one (f32, i32) set
+
+
+def test_dense_counters_single_worker():
+    params = _tiny_params()
+    grads = _tiny_grads(params)
+    n = sum(x.size for x in jax.tree.leaves(params))
+    tx = gtopk_sgd(0.1, compression="dense", axis_name=None, telemetry=True)
+    state = tx.init(params)
+    _, state = jax.jit(tx.update)(grads, state, params)
+    tel = {k: float(v) for k, v in state.telemetry.items()}
+    assert tel["achieved_density"] == 1.0
+    assert tel["sent_elems"] == n
+    assert tel["residual_norm"] == 0.0       # dense mode: no error feedback
+    assert tel["tau"] == 0.0
+    assert tel["grad_norm_post"] == pytest.approx(tel["grad_norm_pre"],
+                                                  rel=1e-6)
+    assert tel["wire_bytes"] == 4 * n
+
+
+def test_layerwise_counters_respect_per_leaf_quota():
+    params = _tiny_params()
+    grads = _tiny_grads(params)
+    rho = 0.05
+    tx = gtopk_sgd(0.1, compression="gtopk_layerwise", density=rho,
+                   axis_name=None, telemetry=True)
+    state = tx.init(params)
+    _, state = jax.jit(tx.update)(grads, state, params)
+    tel = {k: float(v) for k, v in state.telemetry.items()}
+    k_total = sum(k_for_density(int(x.size), rho)
+                  for x in jax.tree.leaves(params))
+    assert abs(tel["sent_elems"] - k_total) <= 1
+    assert tel["tau"] > 0 and tel["residual_norm"] > 0
+
+
+def test_telemetry_off_keeps_state_empty():
+    params = _tiny_params()
+    tx = gtopk_sgd(0.1, compression="gtopk", density=0.05, axis_name=None)
+    state = tx.init(params)
+    assert state.telemetry == ()
+    _, state = jax.jit(tx.update)(_tiny_grads(params), state, params)
+    assert state.telemetry == ()
+
+
+def test_warmup_phase_reads_as_dense_then_sparse():
+    params = _tiny_params()
+    grads = _tiny_grads(params)
+    tx = gtopk_sgd(0.1, compression="gtopk", density=0.05, axis_name=None,
+                   warmup_dense_steps=1, telemetry=True)
+    state = tx.init(params)
+    _, state = jax.jit(tx.update)(grads, state, params)
+    assert float(state.telemetry["achieved_density"]) == pytest.approx(
+        1.0, rel=1e-6)                                        # warm-up step
+    _, state = jax.jit(tx.update)(grads, state, params)
+    assert float(state.telemetry["achieved_density"]) < 0.1   # sparse now
+
+
+def test_counters_replicated_under_spmd_mesh():
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from gtopkssgd_tpu.optimizer import (
+        GTopKSGDState,
+        expand_residual_per_device,
+    )
+
+    p = 8
+    mesh = Mesh(np.array(jax.devices()[:p]), ("dp",))
+    params = _tiny_params()
+    n = sum(x.size for x in jax.tree.leaves(params))
+    rho = 0.05
+    tx = gtopk_sgd(0.1, compression="gtopk", density=rho, axis_name="dp",
+                   telemetry=True)
+    state = expand_residual_per_device(jax.jit(tx.init)(params), p, mesh)
+    spec = GTopKSGDState(count=P(), residual=P("dp"), inner=P(),
+                         telemetry=P())
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), spec, P()),
+             out_specs=(P(), spec), check_vma=False)
+    def step(grads, st, prms):
+        g = jax.tree.map(lambda x: x[0], grads)
+        s = st._replace(residual=jax.tree.map(lambda r: r[0], st.residual))
+        upd, s2 = tx.update(g, s, prms)
+        return upd, s2._replace(
+            residual=jax.tree.map(lambda r: r[None], s2.residual))
+
+    base = _tiny_grads(params)
+    grads = jax.tree.map(
+        lambda x: jnp.stack([x * (1.0 + 0.1 * i) for i in range(p)]), base)
+    _, state = jax.jit(step)(grads, state, params)
+    tel = {k: float(v) for k, v in state.telemetry.items()}
+    k = k_for_density(n, rho)
+    assert abs(tel["sent_elems"] - k) <= 1    # pmean of identical counts
+    assert tel["tau"] > 0 and tel["residual_norm"] > 0
+    # wire model: gtopk hypercube sends k pairs per round, log2(p) rounds
+    assert tel["wire_bytes"] == 8 * k * int(np.log2(p))
+
+
+def test_counter_helpers_edge_cases():
+    assert float(obs_counters.tree_l2(())) == 0.0
+    assert float(obs_counters.selected_tau(jnp.zeros(4))) == 0.0
+    vals = jnp.array([0.0, -0.5, 2.0, 0.0])
+    assert float(obs_counters.selected_tau(vals)) == 0.5
+    assert float(obs_counters.sent_count(vals)) == 2.0
+    keep = jnp.array([False, True, True, False])
+    acc = jnp.array([9.0, -3.0, 1.0, 9.0])
+    assert float(obs_counters.keep_tau(keep, acc)) == 1.0
+    assert float(obs_counters.keep_tau(jnp.zeros(4, bool), acc)) == 0.0
+    # residual_l2 reads v (not u) under momentum correction
+    res = {"v": jnp.array([3.0, 4.0]), "u": jnp.array([100.0, 100.0])}
+    assert float(obs_counters.residual_l2(res)) == 5.0
+
+
+# --------------------------------------------------------------- spans
+
+def test_span_nesting_builds_paths():
+    tr = Tracer()
+    with tr.span("train"):
+        with tr.span("io"):
+            pass
+        with tr.span("dispatch"):
+            pass
+    with tr.span("eval"):
+        pass
+    summary = tr.stats.summary()
+    assert set(summary) == {"train", "train/io", "train/dispatch", "eval"}
+    assert all(sec >= 0 for sec in summary.values())
+    assert tr.current_path == ""             # stack fully unwound
+
+
+def test_span_nesting_is_per_thread():
+    tr = Tracer()
+    seen = {}
+    release = threading.Event()
+
+    def worker():
+        with tr.span("worker_phase"):
+            seen["inside"] = tr.current_path
+            release.wait(2.0)
+
+    with tr.span("main_phase"):
+        t = threading.Thread(target=worker)
+        t.start()
+        while "inside" not in seen and t.is_alive():
+            time.sleep(0.01)
+        # the worker's open span must not nest under main's
+        assert seen["inside"] == "worker_phase"
+        release.set()
+        t.join()
+    assert "main_phase/worker_phase" not in tr.stats.summary()
+
+
+def test_span_flush_logs_one_record_and_resets(tmp_path):
+    with MetricsLogger(str(tmp_path)) as metrics:
+        tr = Tracer(metrics=metrics)
+        with tr.span("io"):
+            pass
+        summary = tr.flush(step=7)
+        assert "io" in summary
+        assert tr.stats.summary() == {}      # reset after flush
+        assert tr.flush(step=8) == {}        # empty window -> no record
+    recs = [json.loads(l) for l in
+            open(os.path.join(tmp_path, "metrics.jsonl"))]
+    spans = [r for r in recs if r["kind"] == "spans"]
+    assert len(spans) == 1 and spans[0]["step"] == 7 and "io" in spans[0]
+
+
+def test_disabled_tracer_and_decorator():
+    tr = Tracer(enabled=False)
+    with tr.span("x"):
+        pass
+    assert tr.stats.summary() == {}
+    tr2 = Tracer()
+
+    @tr2.annotate()
+    def compute():
+        return 41 + 1
+
+    assert compute() == 42
+    assert "compute" in tr2.stats.summary()
+
+
+# ------------------------------------------------------------ watchdog
+
+def test_watchdog_fires_on_stalled_region():
+    fired = []
+    wd = StallWatchdog(0.15, poll_s=0.03, on_stall=fired.append,
+                       diagnostics=lambda: {"phase_means_s": {"io": 1.5}})
+    try:
+        wd.arm("train_step", step=12)
+        wd.heartbeat(step=12)
+        deadline = time.monotonic() + 3.0
+        while not wd.fired and time.monotonic() < deadline:
+            time.sleep(0.02)                 # the "stalled" main thread
+        assert wd.fired
+        (rec,) = fired
+        assert rec["kind"] == "stall"
+        assert rec["label"] == "train_step"
+        assert rec["armed_step"] == 12
+        assert rec["last_completed_step"] == 12
+        assert rec["waited_s"] >= 0.15
+        assert rec["phase_means_s"] == {"io": 1.5}
+        assert "device" in rec
+    finally:
+        wd.close()
+
+
+def test_watchdog_heartbeat_prevents_firing():
+    fired = []
+    wd = StallWatchdog(0.25, poll_s=0.03, on_stall=fired.append)
+    try:
+        wd.arm("train", step=0)
+        for s in range(8):                   # 0.4s total, never 0.25s idle
+            time.sleep(0.05)
+            wd.heartbeat(step=s)
+        wd.disarm()
+        time.sleep(0.3)                      # disarmed: silence
+        assert not wd.fired and fired == []
+    finally:
+        wd.close()
+
+
+def test_watchdog_fires_once_and_validates():
+    with pytest.raises(ValueError):
+        StallWatchdog(0.0)
+    fired = []
+    wd = StallWatchdog(0.05, poll_s=0.02, on_stall=fired.append)
+    try:
+        with wd.watch("region"):
+            time.sleep(0.4)                  # several deadlines deep
+        time.sleep(0.1)
+        assert len(fired) == 1               # one diagnostic, not a storm
+    finally:
+        wd.close()
+
+
+def test_watchdog_diagnostics_failure_is_contained():
+    fired = []
+
+    def bad_diag():
+        raise RuntimeError("host state gone")
+
+    wd = StallWatchdog(0.05, poll_s=0.02, on_stall=fired.append,
+                       diagnostics=bad_diag)
+    try:
+        wd.arm("x")
+        deadline = time.monotonic() + 2.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fired and "diagnostics_error" in fired[0]
+    finally:
+        wd.close()
+
+
+# ----------------------------------------------------------- report CLI
+
+def _write_run(path, rows):
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "metrics.jsonl"), "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+
+
+def test_report_roundtrips_synthetic_run(tmp_path, capsys):
+    run = str(tmp_path / "runA")
+    _write_run(run, [
+        {"kind": "train", "time": 1.0, "rank": 0, "step": 10, "loss": 2.5},
+        {"kind": "train", "time": 2.0, "rank": 0, "step": 20, "loss": 2.0},
+        {"kind": "obs", "time": 2.0, "rank": 0, "step": 20,
+         "achieved_density": 0.001, "wire_bytes": 21800.0},
+    ])
+    # torn final line (the watchdog hard-exit case) must not be fatal
+    with open(os.path.join(run, "metrics.jsonl"), "a") as fh:
+        fh.write('{"kind": "train", "loss": 1.')
+    assert obs_report.main([run]) == 0
+    out = capsys.readouterr().out
+    assert "skipped 1 malformed line" in out
+    assert "[train]" in out and "[obs]" in out
+    assert "achieved_density" in out and "loss" in out
+    summary = obs_report.summarize(obs_report.load_records(run)[0])
+    assert summary["train"]["loss"] == {
+        "count": 2, "mean": 2.25, "min": 2.0, "max": 2.5, "last": 2.0}
+
+
+def test_report_compares_two_runs(tmp_path, capsys):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    _write_run(a, [{"kind": "obs", "time": 1.0, "rank": 0,
+                    "wire_bytes": 100.0, "achieved_density": 0.001}])
+    _write_run(b, [{"kind": "obs", "time": 1.0, "rank": 0,
+                    "wire_bytes": 300.0, "achieved_density": 0.001}])
+    json_out = str(tmp_path / "diff.json")
+    assert obs_report.main([a, b, "--json", json_out]) == 0
+    out = capsys.readouterr().out
+    assert "wire_bytes" in out and "+200" in out
+    with open(json_out) as fh:
+        payload = json.load(fh)
+    d = payload["diff"]["obs"]["wire_bytes"]
+    assert d["delta"] == 200.0 and d["delta_pct"] == pytest.approx(200.0)
+
+
+def test_report_errors_are_exit_code_2(tmp_path, capsys):
+    assert obs_report.main([str(tmp_path / "missing")]) == 2
+    capsys.readouterr()
+
+
+# ----------------------------------------------- metrics logger lifecycle
+
+def test_metrics_logger_context_manager(tmp_path):
+    with MetricsLogger(str(tmp_path)) as m:
+        m.log("train", step=1, loss=2.0)
+        m.log("eval", step=1, top1=0.5)
+    assert m._fh is None                     # guaranteed close on exit
+    recs = [json.loads(l) for l in
+            open(os.path.join(tmp_path, "metrics.jsonl"))]
+    assert [r["kind"] for r in recs] == ["train", "eval"]
+    m.log("train", step=2, loss=1.0)         # post-close: no crash, no write
+    assert len(open(os.path.join(tmp_path, "metrics.jsonl")).readlines()) == 2
+
+
+def test_metrics_logger_rank_nonzero_writes_nothing(tmp_path):
+    with MetricsLogger(str(tmp_path / "r1"), rank=1) as m:
+        m.log("train", step=1, loss=2.0)
+    assert not os.path.exists(str(tmp_path / "r1" / "metrics.jsonl"))
+
+
+# ------------------------------------------------------- trainer smoke
+
+def test_trainer_emits_obs_records_and_report_reads_them(tmp_path):
+    from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+    out = str(tmp_path / "run")
+    with Trainer(TrainConfig(
+            dnn="resnet20", batch_size=4, nworkers=1, compression="gtopk",
+            density=0.01, log_interval=2, eval_batches=1, max_epochs=1,
+            out_dir=out)) as t:
+        t.train(2)
+    recs = [json.loads(l) for l in open(os.path.join(out, "metrics.jsonl"))]
+    obs = [r for r in recs if r["kind"] == "obs"]
+    assert len(obs) == 2                     # obs_interval=1 -> per step
+    for r in obs:
+        for field in ("achieved_density", "tau", "residual_norm",
+                      "wire_bytes", "grad_norm_pre", "grad_norm_post",
+                      "sent_elems", "step"):
+            assert field in r
+    assert any(r["kind"] == "spans" for r in recs)  # tracer flushed
+    # the report CLI aggregates what the trainer wrote
+    summary = obs_report.summarize(recs)
+    assert summary["obs"]["achieved_density"]["count"] == 2

