@@ -57,10 +57,27 @@ def _fail_fast_if_backend_dead(timeout_s: float = 180.0) -> None:
     wait at zero extra cost on the healthy path; a backend that
     initializes but ERRORS returns normally here and the real error
     surfaces from main()'s own first jax call."""
+    import time
+
     from gtopkssgd_tpu.utils import init_backend_with_deadline
 
+    t0 = time.monotonic()
     if init_backend_with_deadline(timeout_s):
         return
+    # Leave a machine-readable record of the dead tunnel, not just rc=3:
+    # the retry/post-mortem tooling reads these (same schema as
+    # benchmarks/backend_probe.py, which onchip_retry.sh emits per
+    # attempt).
+    from benchmarks.backend_probe import append_jsonl, make_record
+
+    rec = make_record(alive=False, timeout_s=timeout_s,
+                      elapsed_s=time.monotonic() - t0, hung=True,
+                      source="bench.py")
+    print(json.dumps(rec, sort_keys=True), file=sys.stderr)
+    try:
+        append_jsonl(rec, "/tmp/backend_probe.jsonl")
+    except OSError:
+        pass
     print(f"bench.py: accelerator backend init still blocked after "
           f"{timeout_s:.0f}s (dead device tunnel?); refusing to hang — "
           "fix the tunnel and re-run", file=sys.stderr)

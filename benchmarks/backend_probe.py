@@ -1,0 +1,118 @@
+"""Structured backend-liveness probe: one JSONL record per attempt.
+
+The round-2/3 post-mortems had to reconstruct WHEN the axon tunnel died
+from shell-log timestamps around an opaque ``rc=3`` — the probes knew
+(attempt number, how long init blocked, what the first jax call raised)
+and threw it away. This probe keeps the exact liveness semantics of
+``utils.init_backend_with_deadline`` (init on a daemon thread, bounded
+wait; a hung PJRT client creation cannot be cancelled, only abandoned)
+but records every attempt as one JSONL line:
+
+    {"kind": "backend_probe", "time": ..., "attempt": 3, "timeout_s": 180,
+     "elapsed_s": 180.0, "alive": false, "hung": true}
+
+plus ``backend``/``device_count`` when init succeeds and the exception
+tail when it errors. Unlike init_backend_with_deadline (which reports an
+ERRORING init as "alive" so the caller's own jax call surfaces the real
+message), the probe classifies an init error as NOT alive — a retry loop
+must not fire a multi-hour queue drain at a backend that raises.
+
+Exit code: 0 alive, 3 dead/hung (bench.py's dead-tunnel convention).
+
+Usage (from onchip_retry.sh):
+  python benchmarks/backend_probe.py --timeout 180 --attempt "$try" \
+      --log "$OUT/backend_probe.jsonl"
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+DEAD_RC = 3
+
+
+def make_record(alive: bool, timeout_s: float, elapsed_s: float,
+                attempt: Optional[int] = None, **extra) -> Dict[str, Any]:
+    rec: Dict[str, Any] = {
+        "kind": "backend_probe",
+        "time": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "alive": bool(alive),
+        "timeout_s": float(timeout_s),
+        "elapsed_s": round(float(elapsed_s), 3),
+    }
+    if attempt is not None:
+        rec["attempt"] = int(attempt)
+    rec.update(extra)
+    return rec
+
+
+def run_probe(timeout_s: float = 180.0,
+              attempt: Optional[int] = None) -> Dict[str, Any]:
+    """Probe THIS process's jax backend with a bounded wait."""
+    holder: Dict[str, Any] = {}
+    done = threading.Event()
+
+    def _init():
+        try:
+            import jax
+
+            holder["device_count"] = int(jax.device_count())
+            holder["backend"] = jax.default_backend()
+        except Exception as e:
+            holder["error"] = "".join(
+                traceback.format_exception_only(type(e), e)).strip()[-500:]
+        finally:
+            done.set()
+
+    t0 = time.monotonic()
+    threading.Thread(target=_init, daemon=True,
+                     name="backend-probe-init").start()
+    finished = done.wait(timeout_s)
+    return make_record(
+        alive=finished and "error" not in holder,
+        timeout_s=timeout_s,
+        elapsed_s=time.monotonic() - t0,
+        attempt=attempt,
+        hung=not finished,
+        **{k: holder[k] for k in ("backend", "device_count", "error")
+           if k in holder},
+    )
+
+
+def append_jsonl(rec: Dict[str, Any], path: str) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as fh:
+        fh.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        "backend_probe",
+        description="Probe jax backend liveness; emit one JSONL record.")
+    ap.add_argument("--timeout", type=float, default=180.0)
+    ap.add_argument("--attempt", type=int, default=None,
+                    help="retry-loop attempt number, recorded verbatim")
+    ap.add_argument("--log", default=None,
+                    help="append the record to this JSONL file as well "
+                         "as printing it")
+    args = ap.parse_args(argv)
+    rec = run_probe(args.timeout, attempt=args.attempt)
+    print(json.dumps(rec, sort_keys=True), flush=True)
+    if args.log:
+        append_jsonl(rec, args.log)
+    return 0 if rec["alive"] else DEAD_RC
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -353,6 +353,14 @@ def main():
     partial = out + ".partial"
     curves, summaries = {}, []
     with open(partial, "w") as fh:
+        # Self-describing artifact: the same manifest header metrics.jsonl
+        # carries (config hash over the argparse namespace, backend, git
+        # sha). --recompute passes it through untouched as an extras row.
+        from gtopkssgd_tpu.obs.manifest import run_manifest
+
+        fh.write(json.dumps(
+            {**run_manifest(vars(args)), "kind": "manifest"}) + "\n")
+        fh.flush()
         for mode in args.modes.split(","):
             mode = mode.strip()
             print(f"[convergence] {args.dnn} {mode} rho={args.density} "

@@ -1,0 +1,104 @@
+"""Gate smoke: the canonical tiny CPU run behind the obs regression gate.
+
+ONE place defines the run that the committed baseline
+(benchmarks/results/obs_gate_baseline_cpu.json) describes: a few
+gtopk_layerwise steps of resnet20 on a 2-way CPU mesh with per-layer
+telemetry and the recall audit on. Both consumers import it:
+
+  tests/test_obs.py         runs it in-process and asserts
+                            ``report gate`` exits 0 against the committed
+                            baseline — the tier-1 drift gate.
+  this file as a script     regenerates the run and, with
+                            --write-baseline, re-stamps the baseline's
+                            expectations (after an INTENTIONAL behavior
+                            change; review the JSON diff like code).
+
+Tolerances live in the baseline, not here: tight (5%) on structurally
+deterministic counters (sent_elems, wire_bytes, achieved_density — fixed
+by k and the layer shapes), loose on value-dependent statistics (norms,
+m(k), recall) that may wobble with compiler version or thread count.
+
+Usage:
+  python benchmarks/obs_gate_smoke.py                  # run + gate
+  python benchmarks/obs_gate_smoke.py --write-baseline # regenerate
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "results",
+    "obs_gate_baseline_cpu.json")
+
+SMOKE_STEPS = 4
+
+
+def smoke_config(out_dir: str):
+    """The canonical gate-smoke TrainConfig. Any field change here
+    invalidates the committed baseline — regenerate it in the same
+    commit (--write-baseline)."""
+    from gtopkssgd_tpu.trainer import TrainConfig
+
+    return TrainConfig(
+        dnn="resnet20",
+        batch_size=4,
+        nworkers=2,
+        compression="gtopk_layerwise",
+        density=0.01,
+        seed=42,
+        max_epochs=1,
+        log_interval=2,
+        eval_batches=1,
+        obs_layers=True,
+        obs_audit_interval=2,
+        obs_interval=2,
+        out_dir=out_dir,
+    )
+
+
+def run_smoke(out_dir: str) -> str:
+    """Train the canonical run; returns the run dir (metrics.jsonl inside)."""
+    from gtopkssgd_tpu.trainer import Trainer
+
+    with Trainer(smoke_config(out_dir)) as t:
+        t.train(SMOKE_STEPS)
+    return out_dir
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        "obs_gate_smoke",
+        description="Run the canonical obs-gate smoke and gate (or "
+                    "regenerate) the committed baseline.")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="re-stamp the committed baseline's expectations "
+                         "from this run instead of failing on drift")
+    ap.add_argument("--out-dir", default=None,
+                    help="keep the run here (default: a temp dir)")
+    args = ap.parse_args(argv)
+
+    # Same in-process CPU-mesh workaround as tests/conftest.py: this
+    # host's sitecustomize overrides JAX_PLATFORMS, so an env var alone
+    # would silently dial the accelerator tunnel.
+    from gtopkssgd_tpu.utils import enable_compilation_cache, force_cpu_mesh
+
+    force_cpu_mesh(smoke_config("ignored").nworkers)
+    enable_compilation_cache()
+
+    out = args.out_dir or tempfile.mkdtemp(prefix="obs_gate_smoke_")
+    run_smoke(out)
+
+    from gtopkssgd_tpu.obs import report
+
+    write = BASELINE if args.write_baseline else None
+    return report.run_gate(out, BASELINE, write=write)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

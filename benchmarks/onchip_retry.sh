@@ -15,12 +15,15 @@ OUT=${1:-/tmp/onchip_queue}
 MAX=${2:-40}
 log() { echo "[onchip_retry $(date -u +%H:%M:%S)] $*"; }
 
+mkdir -p "$OUT"
 for try in $(seq 1 "$MAX"); do
     log "attempt $try/$MAX: probe"
-    if python - <<'EOF'
-from gtopkssgd_tpu.utils import init_backend_with_deadline
-raise SystemExit(0 if init_backend_with_deadline(180) else 1)
-EOF
+    # Structured probe: same bounded-wait init as before, but every
+    # attempt leaves a JSONL record (timestamp, attempt, elapsed, error
+    # tail) in $OUT/backend_probe.jsonl — the rounds-2/3 post-mortems
+    # had to reconstruct exactly this from shell timestamps.
+    if python benchmarks/backend_probe.py --timeout 180 \
+        --attempt "$try" --log "$OUT/backend_probe.jsonl"
     then
         log "backend alive; draining queue"
         # Bound the drain: a tunnel that wedges MID-drain (rounds 2+3

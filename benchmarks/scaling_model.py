@@ -126,7 +126,12 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
             ici_rounds, flat_dcn_rounds = total_rounds, 0
         else:
             m = 1 << (p.bit_length() - 1)
-            ici_rounds = int(math.log2(min(m, s)))
+            # floor(log2) via bit_length, not int(math.log2(...)): s is
+            # whatever --ici-size the user typed, and the float path
+            # silently truncates non-powers-of-two (and can misround at
+            # large exact powers); hypercube rounds pair by XOR bit, so
+            # floor(log2) is the intended count for ragged s too.
+            ici_rounds = min(m, s).bit_length() - 1
             flat_dcn_rounds = total_rounds - ici_rounds
         comm_ms = (ici_rounds * (8 * k) / ici_Bps * 1e3
                    + flat_dcn_rounds * ((8 * k) / dcn_Bps * 1e3

@@ -70,13 +70,18 @@ class TopKCompressor:
         residual = acc.at[idx].set(0.0, mode="drop")
         return vals, idx, residual
 
-    def compress_by_threshold(self, acc: Array) -> Tuple[Array, Array]:
+    def compress_by_threshold(
+        self, acc: Array
+    ) -> Tuple[Array, Array, Array]:
         """Mask-form selection for paths that need no wire format.
 
-        Returns (keep bool[N], residual f32[N]) with
+        Returns (keep bool[N], residual f32[N], kept_tau f32[]) with
         ``keep = |acc| >= tau`` where tau is the k-th largest magnitude
-        (as reported by the configured selection kernel) and
-        ``residual = where(keep, 0, acc)``.
+        (as reported by the configured selection kernel),
+        ``residual = where(keep, 0, acc)``, and ``kept_tau`` the smallest
+        magnitude actually KEPT (0 when the keep set is empty) — the obs
+        ``keep_tau`` convention, reported from here so telemetry callers
+        do not re-reduce the same mask.
 
         Semantically this is the same partition as ``compress`` —
         selected entries leave the residual, everything else stays — but
@@ -107,7 +112,10 @@ class TopKCompressor:
         vals, _ = select_topk(acc, self.k(n), self.method)
         tau = jnp.min(jnp.abs(vals))
         keep = (jnp.abs(acc) >= tau) & (jnp.abs(acc) > 0.0)
-        return keep, jnp.where(keep, 0.0, acc)
+        kept_tau = jnp.min(jnp.where(keep, jnp.abs(acc), jnp.inf))
+        kept_tau = jnp.where(
+            jnp.isfinite(kept_tau), kept_tau, 0.0).astype(jnp.float32)
+        return keep, jnp.where(keep, 0.0, acc), kept_tau
 
     def repair(
         self,

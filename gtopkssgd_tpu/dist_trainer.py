@@ -27,6 +27,17 @@ reference's only telemetry was text logs):
     --obs-interval N                     log "obs" every N steps (reading
                                          counters syncs on the step; raise
                                          to preserve dispatch overlap)
+    --obs-layers                         per-layer compression telemetry
+                                         (density, tau, norms, residual
+                                         age, mass-capture m(k)) as one
+                                         "layers" record per layer per obs
+                                         step (default off; adds [L]-sized
+                                         optimizer state)
+    --obs-audit-interval N               every N steps, audit the
+                                         production top-k selection against
+                                         the exact top-k (recall in the
+                                         "obs" record's audit_recall;
+                                         0 = off)
     --obs-watchdog SECONDS               dispatch stall watchdog: fail fast
                                          with a structured diagnostic (exit
                                          43) instead of hanging forever on
@@ -133,6 +144,20 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="log an 'obs' record every N optimizer steps; "
                         "reading counters syncs on the dispatched step, "
                         "so raise this to keep async dispatch overlap")
+    p.add_argument("--obs-layers", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="per-layer compression-quality telemetry "
+                        "(obs.counters.LAYER_FIELDS) logged as one "
+                        "'layers' record per layer per obs step; opt-in "
+                        "because it adds [L]-sized optimizer state "
+                        "(checkpoint treedef change) and a few segment "
+                        "reductions to the jitted step")
+    p.add_argument("--obs-audit-interval", type=int, default=0,
+                   help="every N optimizer steps, audit the production "
+                        "top-k selection against the exact top-k of the "
+                        "accumulator (ops.topk exact path); recall lands "
+                        "in the 'obs' record's audit_recall field "
+                        "(-1 = never audited); 0 disables")
     p.add_argument("--obs-watchdog", type=float, default=0.0,
                    help="seconds a dispatched step may go without host-"
                         "visible progress before the stall watchdog dumps "
@@ -181,6 +206,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         log_interval=args.log_interval,
         obs_counters=args.obs_counters,
         obs_interval=args.obs_interval,
+        obs_layers=args.obs_layers,
+        obs_audit_interval=args.obs_audit_interval,
         obs_watchdog=args.obs_watchdog,
         prefetch=args.prefetch,
         decode_workers=args.decode_workers,

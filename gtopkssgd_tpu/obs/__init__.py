@@ -20,30 +20,56 @@ residual dynamics). This package turns the repo's scattered primitives
       BENCH_r05 dead-tunnel mode), emits a structured diagnostic and
       fails fast instead of hanging.
   report.py   — ``python -m gtopkssgd_tpu.obs.report`` aggregates one or
-      two metrics.jsonl runs into per-kind/per-metric summaries and a
-      side-by-side regression-triage comparison.
+      two metrics.jsonl runs into per-kind/per-metric summaries (incl.
+      per-layer breakdown tables from "layers" records), a side-by-side
+      regression-triage comparison, and a ``gate`` subcommand diffing a
+      run against a committed baseline JSON with per-field tolerances
+      (nonzero exit on regression — the tier-1 drift gate).
+  manifest.py — run-manifest header (config hash, resolved headline
+      flags, mesh shape, jax/backend versions, git sha) written as the
+      first record of every metrics.jsonl so runs are self-describing.
+
+Per-layer counters (counters.LAYER_FIELDS, flag-gated): achieved
+density, tau, pre/post-compression norms, error-feedback residual norm
+and mean residual AGE (steps since a coordinate last shipped), and the
+mass-capture ratio m(k) = ||selected||^2/||acc||^2 whose per-layer skew
+explains top-k convergence gaps (arXiv:1911.08772) — plus a sampled
+exact-vs-production top-k recall audit reusing ops.topk's exact path as
+ground truth.
 """
 
 from gtopkssgd_tpu.obs.counters import (
+    LAYER_FIELDS,
     TELEMETRY_FIELDS,
     keep_tau,
+    layer_names,
     make_telemetry,
+    mass_ratio,
     selected_tau,
     sent_count,
+    topk_recall,
     tree_l2,
     zero_telemetry,
 )
+from gtopkssgd_tpu.obs.manifest import config_hash, git_sha, run_manifest
 from gtopkssgd_tpu.obs.tracing import Tracer
 from gtopkssgd_tpu.obs.watchdog import StallWatchdog
 
 __all__ = [
+    "LAYER_FIELDS",
     "TELEMETRY_FIELDS",
     "Tracer",
     "StallWatchdog",
+    "config_hash",
+    "git_sha",
     "keep_tau",
+    "layer_names",
     "make_telemetry",
+    "mass_ratio",
+    "run_manifest",
     "selected_tau",
     "sent_count",
+    "topk_recall",
     "tree_l2",
     "zero_telemetry",
 ]

@@ -32,10 +32,11 @@ a dashboard anyway).
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from gtopkssgd_tpu.parallel import comm_bytes_per_step
 
@@ -49,7 +50,25 @@ TELEMETRY_FIELDS = (
     "sent_elems",
     "achieved_density",
     "wire_bytes",
+    "m_k",
 )
+
+# Per-layer counter set (telemetry_layers=True). The mass-capture ratio
+# m(k) = ||selected||^2 / ||acc||^2 and its per-layer skew are the
+# quantities arXiv:1911.08772 ties to the top-k convergence gap;
+# residual_age is the mean steps-since-a-coordinate-last-shipped, the
+# staleness axis the whole-model residual norm cannot resolve.
+LAYER_FIELDS = (
+    "density",
+    "tau",
+    "grad_norm_pre",
+    "grad_norm_post",
+    "residual_norm",
+    "residual_age",
+    "m_k",
+)
+
+_MASS_EPS = 1e-30
 
 
 def zero_telemetry() -> Dict[str, Array]:
@@ -114,6 +133,7 @@ def make_telemetry(
     residual_norm,
     tau,
     sent_elems,
+    m_k=0.0,
 ) -> Dict[str, Array]:
     """Assemble the per-step telemetry dict (all f32 scalars).
 
@@ -133,4 +153,268 @@ def make_telemetry(
         "wire_bytes": jnp.float32(
             comm_bytes_per_step(mode, n, k, p, ici_size=ici_size)
         ),
+        "m_k": jnp.asarray(m_k, jnp.float32),
     }
+
+
+# --------------------------------------------------------------------------
+# Per-layer counters (telemetry_layers). Everything below is still pure jnp
+# traced inside the jitted step; layer identity is static trace-time
+# structure (the grads pytree), so the only runtime cost is a handful of
+# segment reductions over arrays the step already materializes.
+# --------------------------------------------------------------------------
+
+
+def layer_names(tree) -> Tuple[str, ...]:
+    """Stable per-leaf names in jax.tree.flatten order — '/'-joined pytree
+    key paths ('block1/conv1/kernel' for nested flax params). This is the
+    SAME order ravel_pytree and the layerwise residual use, so index i of
+    every [L] layer-stat array refers to names()[i]."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, _ in leaves:
+        parts = []
+        for k in path:
+            parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+        out.append("/".join(parts) if parts else "param")
+    return tuple(out)
+
+
+def layer_sizes(tree) -> Tuple[int, ...]:
+    """Per-leaf element counts in the same flatten order as layer_names."""
+    return tuple(int(x.size) for x in jax.tree.leaves(tree))
+
+
+def segment_ids(sizes: Sequence[int]) -> np.ndarray:
+    """i32[N] coordinate->layer map for the flat [N] gradient layout — a
+    trace-time numpy constant (XLA folds it), shared by every flat-mode
+    segment reduction so layer boundaries cannot drift between fields."""
+    return np.repeat(
+        np.arange(len(sizes), dtype=np.int32), np.asarray(sizes, np.int64)
+    )
+
+
+def zero_layer_telemetry(sizes: Sequence[int], *, per_leaf_age: bool):
+    """Zero per-layer structure for init_fn: [L] zeros per LAYER_FIELDS
+    plus the residual-age buffer in the residual's own layout (flat [N]
+    for flat modes, per-leaf tuple for layerwise) so the state treedef is
+    identical at step 0 and step k."""
+    L = len(sizes)
+    if per_leaf_age:
+        age = tuple(jnp.zeros((int(s),), jnp.float32) for s in sizes)
+    else:
+        age = jnp.zeros((int(sum(sizes)),), jnp.float32)
+    return {
+        "layers": {f: jnp.zeros((L,), jnp.float32) for f in LAYER_FIELDS},
+        "age": age,
+    }
+
+
+def seg_l2(x: Array, seg: np.ndarray, L: int) -> Array:
+    """Per-layer L2 norms of a flat [N] vector in one segment reduction."""
+    x = x.astype(jnp.float32)
+    return jnp.sqrt(jax.ops.segment_sum(
+        x * x, seg, num_segments=L, indices_are_sorted=True))
+
+
+def _tree_sq(tree) -> Array:
+    return sum(
+        jnp.sum(jnp.square(x.astype(jnp.float32)))
+        for x in jax.tree.leaves(tree)
+    )
+
+
+def mass_ratio(acc, selected) -> Array:
+    """Whole-model mass-capture ratio m(k) = ||selected||^2 / ||acc||^2
+    (arXiv:1911.08772). Both args may be arrays or pytrees of arrays;
+    ``selected`` may be the densified selection or just the selected
+    values — only its mass matters."""
+    return _tree_sq(selected) / jnp.maximum(_tree_sq(acc), _MASS_EPS)
+
+
+def leaf_l2(arrs: Sequence[Array]) -> Array:
+    """Stacked per-leaf L2 norms, f32[L] — the layerwise-mode counterpart
+    of seg_l2 (one small reduction per leaf; no flat vector exists)."""
+    return jnp.stack([
+        jnp.sqrt(jnp.sum(jnp.square(a.astype(jnp.float32)))) for a in arrs
+    ])
+
+
+def selection_layer_stats(
+    acc: Array, sel_dense: Array, seg: np.ndarray, L: int
+) -> Tuple[Dict[str, Array], Array]:
+    """Per-layer selection stats for the flat [N] layout.
+
+    ``sel_dense`` is the locally-selected set densified (selected values
+    in place, 0 elsewhere — the threshold path's ``acc - residual``, or a
+    scatter of (vals, idx) for the index form). Returns
+    ({sent, tau, m_k} as f32[L], whole-model m_k). A value-0 selection
+    slot counts as not sent, matching sent_count's convention."""
+    mask = sel_dense != 0
+    sent = jax.ops.segment_sum(
+        mask.astype(jnp.float32), seg, num_segments=L,
+        indices_are_sorted=True)
+    mags = jnp.abs(sel_dense)
+    tau = jax.ops.segment_min(
+        jnp.where(mask, mags, jnp.inf), seg, num_segments=L,
+        indices_are_sorted=True)
+    tau = jnp.where(jnp.isfinite(tau), tau, 0.0).astype(jnp.float32)
+    acc32 = acc.astype(jnp.float32)
+    sel32 = sel_dense.astype(jnp.float32)
+    acc_sq = jax.ops.segment_sum(
+        acc32 * acc32, seg, num_segments=L, indices_are_sorted=True)
+    sel_sq = jax.ops.segment_sum(
+        sel32 * sel32, seg, num_segments=L, indices_are_sorted=True)
+    m_k = sel_sq / jnp.maximum(acc_sq, _MASS_EPS)
+    whole = jnp.sum(sel_sq) / jnp.maximum(jnp.sum(acc_sq), _MASS_EPS)
+    return {"sent": sent, "tau": tau, "m_k": m_k}, whole
+
+
+def sparse_selection_layer_stats(
+    acc: Array, vals: Array, idx: Array, seg: np.ndarray, L: int
+) -> Tuple[Dict[str, Array], Array]:
+    """selection_layer_stats for the (vals, idx) wire form, without ever
+    densifying the selection: the selected coordinates' layer ids are a
+    gather ``seg[idx]``, and every per-layer stat is a k-sized segment
+    reduction (k << N), plus one [N] reduction for the per-layer acc
+    mass. A value-0 slot counts as not sent (padding convention)."""
+    mask = vals != 0
+    seg_sel = jnp.take(jnp.asarray(seg), idx, mode="clip")
+    sent = jax.ops.segment_sum(
+        mask.astype(jnp.float32), seg_sel, num_segments=L)
+    tau = jax.ops.segment_min(
+        jnp.where(mask, jnp.abs(vals), jnp.inf), seg_sel, num_segments=L)
+    tau = jnp.where(jnp.isfinite(tau), tau, 0.0).astype(jnp.float32)
+    acc32 = acc.astype(jnp.float32)
+    v32 = vals.astype(jnp.float32)
+    acc_sq = jax.ops.segment_sum(
+        acc32 * acc32, seg, num_segments=L, indices_are_sorted=True)
+    sel_sq = jax.ops.segment_sum(v32 * v32, seg_sel, num_segments=L)
+    m_k = sel_sq / jnp.maximum(acc_sq, _MASS_EPS)
+    whole = jnp.sum(sel_sq) / jnp.maximum(jnp.sum(acc_sq), _MASS_EPS)
+    return {"sent": sent, "tau": tau, "m_k": m_k}, whole
+
+
+def leafwise_selection_stats(
+    accs: Sequence[Array], sel_denses: Sequence[Array]
+) -> Tuple[Dict[str, Array], Array]:
+    """Per-leaf counterpart of selection_layer_stats for the layerwise
+    mode, where the flat [N] vector never exists: one small reduction per
+    leaf, stacked to [L]."""
+    sents, taus, sel_sqs, acc_sqs = [], [], [], []
+    for a, s in zip(accs, sel_denses):
+        mask = s != 0
+        sents.append(jnp.sum(mask.astype(jnp.float32)))
+        t = jnp.min(jnp.where(mask, jnp.abs(s), jnp.inf))
+        taus.append(jnp.where(jnp.any(mask), t, 0.0).astype(jnp.float32))
+        a32, s32 = a.astype(jnp.float32), s.astype(jnp.float32)
+        acc_sqs.append(jnp.sum(a32 * a32))
+        sel_sqs.append(jnp.sum(s32 * s32))
+    sel_sq = jnp.stack(sel_sqs)
+    acc_sq = jnp.stack(acc_sqs)
+    whole = jnp.sum(sel_sq) / jnp.maximum(jnp.sum(acc_sq), _MASS_EPS)
+    return {
+        "sent": jnp.stack(sents),
+        "tau": jnp.stack(taus),
+        "m_k": sel_sq / jnp.maximum(acc_sq, _MASS_EPS),
+    }, whole
+
+
+def leafwise_sparse_selection_stats(
+    accs: Sequence[Array], vals_list: Sequence[Array]
+) -> Tuple[Dict[str, Array], Array]:
+    """Per-leaf stats from each leaf's selected VALUES (layerwise p>1
+    path, where selection is already per leaf): no scatter needed, one
+    k_l-sized reduction per leaf plus the leaf's acc mass."""
+    sents, taus, sel_sqs, acc_sqs = [], [], [], []
+    for a, v in zip(accs, vals_list):
+        mask = v != 0
+        sents.append(jnp.sum(mask.astype(jnp.float32)))
+        t = jnp.min(jnp.where(mask, jnp.abs(v), jnp.inf))
+        taus.append(jnp.where(jnp.any(mask), t, 0.0).astype(jnp.float32))
+        a32, v32 = a.astype(jnp.float32), v.astype(jnp.float32)
+        acc_sqs.append(jnp.sum(a32 * a32))
+        sel_sqs.append(jnp.sum(v32 * v32))
+    sel_sq = jnp.stack(sel_sqs)
+    acc_sq = jnp.stack(acc_sqs)
+    whole = jnp.sum(sel_sq) / jnp.maximum(jnp.sum(acc_sq), _MASS_EPS)
+    return {
+        "sent": jnp.stack(sents),
+        "tau": jnp.stack(taus),
+        "m_k": sel_sq / jnp.maximum(acc_sq, _MASS_EPS),
+    }, whole
+
+
+def dense_phase_selection_stats(
+    sizes: Sequence[int],
+) -> Tuple[Dict[str, Array], Array]:
+    """The dense (no-compression) phase's trivial selection stats:
+    everything ships, so density 1 per layer, no threshold, full mass
+    capture. Used by the dense mode and the warm-up dense branch so both
+    lax.cond arms return an identical structure."""
+    L = len(sizes)
+    return {
+        "sent": jnp.asarray(np.asarray(sizes, np.float32)),
+        "tau": jnp.zeros((L,), jnp.float32),
+        "m_k": jnp.ones((L,), jnp.float32),
+    }, jnp.float32(1.0)
+
+
+def update_age(age, delivered):
+    """Residual-age recursion: a coordinate's age resets to 0 the step it
+    ships (appears in the applied dense update) and grows by 1 otherwise.
+    ``delivered`` is derived from the globally-reduced update, which is
+    replicated across the mesh, so the age buffer stays replicated without
+    any collective. Works leaf-wise (tree.map) for the layerwise layout.
+    Caveat: exact cross-device cancellation of a shipped coordinate reads
+    as not-delivered — an epsilon case on real gradients."""
+    return jax.tree.map(
+        lambda a, d: jnp.where(d, 0.0, a + 1.0), age, delivered)
+
+
+def layer_age_means(age, seg: np.ndarray = None, L: int = 0,
+                    sizes: Sequence[int] = ()) -> Array:
+    """Mean residual age per layer: flat [N] buffer via one segment_sum,
+    per-leaf tuple via per-leaf means."""
+    if isinstance(age, tuple):
+        return jnp.stack([jnp.mean(a) for a in age])
+    total = jax.ops.segment_sum(
+        age, seg, num_segments=L, indices_are_sorted=True)
+    return total / jnp.asarray(np.maximum(np.asarray(sizes, np.float64), 1)
+                               .astype(np.float32))
+
+
+def assemble_layer_telemetry(
+    *,
+    sel_stats: Dict[str, Array],
+    sizes: Sequence[int],
+    grad_norm_pre_l: Array,
+    grad_norm_post_l: Array,
+    residual_norm_l: Array,
+    age,
+    seg: np.ndarray = None,
+) -> Dict[str, Array]:
+    """Glue the branch-dependent selection stats and the branch-independent
+    norms/ages into the LAYER_FIELDS dict carried in state.telemetry."""
+    L = len(sizes)
+    sizes_f = jnp.asarray(np.maximum(np.asarray(sizes, np.float64), 1)
+                          .astype(np.float32))
+    return {
+        "density": sel_stats["sent"] / sizes_f,
+        "tau": sel_stats["tau"],
+        "grad_norm_pre": grad_norm_pre_l,
+        "grad_norm_post": grad_norm_post_l,
+        "residual_norm": residual_norm_l,
+        "residual_age": layer_age_means(age, seg=seg, L=L, sizes=sizes),
+        "m_k": sel_stats["m_k"],
+    }
+
+
+def topk_recall(hits: Array, exact_vals: Array) -> Array:
+    """Recall of the production selection against the exact top-k ground
+    truth: fraction of exact-top-k elements (zero-padding slots excluded)
+    the production path also selected. ``hits`` is bool[k] membership of
+    the exact indices in the selected set."""
+    real = jnp.abs(exact_vals) > 0
+    n_real = jnp.maximum(jnp.sum(real.astype(jnp.float32)), 1.0)
+    return jnp.sum((hits & real).astype(jnp.float32)) / n_real

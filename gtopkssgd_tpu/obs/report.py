@@ -1,15 +1,28 @@
-"""Metrics report CLI: aggregate metrics.jsonl runs, compare two of them.
+"""Metrics report CLI: aggregate metrics.jsonl runs, compare two of them,
+or gate one against a committed baseline.
 
     python -m gtopkssgd_tpu.obs.report <run>            # summarize one run
     python -m gtopkssgd_tpu.obs.report <runA> <runB>    # side-by-side diff
     python -m gtopkssgd_tpu.obs.report <run> --json out.json
+    python -m gtopkssgd_tpu.obs.report gate <run> --baseline base.json
 
 A <run> is a directory containing metrics.jsonl (what --out-dir produces)
 or a path to any .jsonl file of MetricsLogger records. Records group by
 their ``kind`` ("train", "eval", "obs", "spans", "epoch", ...); every
-numeric field gets count/mean/min/max/last. The two-run mode prints mean
-vs. mean with a signed delta per field — the bench-regression triage view
-(was r05 slower because comm grew, or because achieved density drifted?).
+numeric field gets count/mean/min/max/last. When the run has a manifest
+header it is printed first, and "layers" records additionally get a
+per-layer breakdown table (one row per layer, mean of each
+counters.LAYER_FIELDS column). The two-run mode prints mean vs. mean with
+a signed delta per field — the bench-regression triage view (was r05
+slower because comm grew, or because achieved density drifted?).
+
+``gate`` is the regression gate: the baseline JSON carries a ``checks``
+list ({kind, field, stat, expect, rtol, atol, optional layer}) and an
+optional ``manifest`` dict of exact-match provenance keys; a check passes
+iff |actual - expect| <= atol + rtol*|expect|. Exit 0 = all pass, 1 = any
+regression (or a checked field missing from the run), 2 = usage error.
+``--write`` re-stamps the baseline's expectations from the run under test
+(the regeneration path after an intentional behavior change).
 
 Malformed lines are counted and skipped, never fatal: a run killed by the
 stall watchdog (or the kernel) may leave a torn final line, and the whole
@@ -60,6 +73,8 @@ def summarize(records: Iterable[dict]) -> Dict[str, Dict[str, dict]]:
     acc: Dict[str, Dict[str, List[float]]] = {}
     for rec in records:
         kind = str(rec.get("kind", "?"))
+        if kind == "manifest":
+            continue  # provenance header, not a measurement stream
         fields = acc.setdefault(kind, {})
         for key, val in rec.items():
             if key in _META_FIELDS:
@@ -79,6 +94,61 @@ def summarize(records: Iterable[dict]) -> Dict[str, Dict[str, dict]]:
                 "last": vals[-1],
             }
     return out
+
+
+def extract_manifest(records: Iterable[dict]) -> Optional[dict]:
+    """The run's manifest record (kind "manifest"), or None. First wins:
+    the trainer writes it before any measurement record."""
+    for rec in records:
+        if rec.get("kind") == "manifest":
+            return rec
+    return None
+
+
+def summarize_layers(records: Iterable[dict]) -> Dict[str, Dict[str, dict]]:
+    """{layer: {field: {count, mean, min, max, last}}} over the numeric
+    fields of kind=="layers" records (the per-layer telemetry stream)."""
+    by_layer: Dict[str, List[dict]] = {}
+    for rec in records:
+        if rec.get("kind") != "layers":
+            continue
+        by_layer.setdefault(str(rec.get("layer", "?")), []).append(rec)
+    return {
+        layer: summarize(recs).get("layers", {})
+        for layer, recs in by_layer.items()
+    }
+
+
+def format_manifest(man: dict) -> str:
+    rows = [
+        [key, json.dumps(val) if isinstance(val, dict) else str(val)]
+        for key, val in man.items()
+        if key not in _META_FIELDS
+    ]
+    return "[manifest]\n" + _table(rows, ["key", "value"])
+
+
+# Per-layer table column order; "layer" (the row key) and "step" are
+# implicit. Mirrors counters.LAYER_FIELDS without importing jax here.
+_LAYER_COLUMNS = ("density", "tau", "m_k", "residual_age", "residual_norm",
+                  "grad_norm_pre", "grad_norm_post")
+
+
+def format_layers(by_layer: Dict[str, Dict[str, dict]]) -> str:
+    """One row per layer, mean of each per-layer counter over the run."""
+    cols = [c for c in _LAYER_COLUMNS
+            if any(c in fields for fields in by_layer.values())]
+    rows = []
+    for layer in sorted(by_layer):
+        fields = by_layer[layer]
+        rows.append([layer] + [
+            _fmt(fields[c]["mean"]) if c in fields else "-" for c in cols
+        ])
+    n = max((max(s["count"] for s in f.values()) if f else 0)
+            for f in by_layer.values())
+    return (f"[layers] ({len(by_layer)} layers x {n} obs steps; "
+            "mean per layer)\n"
+            + _table(rows, ["layer"] + [f"mean({c})" for c in cols]))
 
 
 def _fmt(v: float) -> str:
@@ -163,6 +233,120 @@ def format_compare(name_a: str, name_b: str,
     return "\n".join(chunks)
 
 
+def _lookup_stat(summary: Dict[str, Dict[str, dict]],
+                 layers: Dict[str, Dict[str, dict]],
+                 check: dict) -> Optional[float]:
+    """Resolve one baseline check against a run's aggregates; None when
+    the kind/layer/field/stat is absent (reported as a failure — a
+    silently vanished counter IS a regression)."""
+    stat = str(check.get("stat", "mean"))
+    if check.get("layer") is not None:
+        fields = layers.get(str(check["layer"]), {})
+    else:
+        fields = summary.get(str(check.get("kind", "obs")), {})
+    entry = fields.get(str(check["field"]))
+    if entry is None or stat not in entry:
+        return None
+    return float(entry[stat])
+
+
+def _check_id(check: dict) -> str:
+    where = (f"layers[{check['layer']}]" if check.get("layer") is not None
+             else str(check.get("kind", "obs")))
+    return f"{where}.{check['field']}.{check.get('stat', 'mean')}"
+
+
+def run_gate(run: str, baseline_path: str,
+             write: Optional[str] = None) -> int:
+    """Diff a run against a committed baseline JSON; 0 pass / 1 fail."""
+    try:
+        with open(baseline_path) as fh:
+            baseline = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"cannot read baseline {baseline_path}: {e}")
+        return 2
+    checks = baseline.get("checks")
+    if not isinstance(checks, list) or not checks:
+        print(f"baseline {baseline_path} has no 'checks' list")
+        return 2
+    try:
+        records, bad = load_records(run)
+    except OSError as e:
+        print(f"cannot read {run}: {e}")
+        return 2
+    if bad:
+        print(f"note: {run}: skipped {bad} malformed line(s)")
+    summary = summarize(records)
+    layers = summarize_layers(records)
+    manifest = extract_manifest(records) or {}
+
+    failures = 0
+    rows = []
+    for key, expect in sorted((baseline.get("manifest") or {}).items()):
+        actual = manifest.get(key)
+        ok = actual == expect
+        failures += not ok
+        rows.append([f"manifest.{key}", json.dumps(expect),
+                     json.dumps(actual), "-", "OK" if ok else "FAIL"])
+    for check in checks:
+        expect = float(check["expect"])
+        rtol = float(check.get("rtol", 0.0))
+        atol = float(check.get("atol", 0.0))
+        tol = atol + rtol * abs(expect)
+        actual = _lookup_stat(summary, layers, check)
+        if actual is None:
+            failures += 1
+            rows.append([_check_id(check), _fmt(expect), "missing",
+                         _fmt(tol), "FAIL"])
+            continue
+        ok = abs(actual - expect) <= tol
+        failures += not ok
+        rows.append([_check_id(check), _fmt(expect), _fmt(actual),
+                     _fmt(tol), "OK" if ok else "FAIL"])
+    print(f"gate: run={run}  baseline={baseline_path}")
+    print(_table(rows, ["check", "expect", "actual", "tol", "status"]))
+    print(f"gate: {len(rows) - failures}/{len(rows)} checks passed")
+
+    if write:
+        # Regeneration path: keep each check's spec (tolerances, stat,
+        # addressing) but re-stamp 'expect' from the run under test, and
+        # refresh the pinned manifest keys. Review the diff like code.
+        new_checks = []
+        for check in checks:
+            actual = _lookup_stat(summary, layers, check)
+            out = dict(check)
+            if actual is not None:
+                out["expect"] = actual
+            new_checks.append(out)
+        new_base = dict(baseline)
+        new_base["checks"] = new_checks
+        if baseline.get("manifest"):
+            new_base["manifest"] = {
+                key: manifest.get(key) for key in baseline["manifest"]
+            }
+        with open(write, "w") as fh:
+            json.dump(new_base, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {write}")
+    return 1 if failures else 0
+
+
+def build_gate_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "gtopkssgd_tpu.obs.report gate",
+        description="Diff a run against a committed baseline JSON; exit "
+                    "nonzero on regression.",
+    )
+    p.add_argument("run", help="an --out-dir or a metrics.jsonl path")
+    p.add_argument("--baseline", required=True,
+                   help="baseline JSON with a 'checks' list and optional "
+                        "'manifest' exact-match dict")
+    p.add_argument("--write", default=None,
+                   help="write a regenerated baseline (same check specs, "
+                        "expectations re-stamped from this run) here")
+    return p
+
+
 def build_argparser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         "gtopkssgd_tpu.obs.report",
@@ -181,13 +365,19 @@ def build_argparser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "gate":
+        gargs = build_gate_argparser().parse_args(argv[1:])
+        return run_gate(gargs.run, gargs.baseline, gargs.write)
     args = build_argparser().parse_args(argv)
     if len(args.runs) > 2:
         print("at most 2 runs (one to summarize, two to compare)")
         return 2
     kinds = ([k.strip() for k in args.kinds.split(",") if k.strip()]
              if args.kinds else None)
-    summaries, names = [], []
+    summaries, names, all_records = [], [], []
     for run in args.runs:
         try:
             records, bad = load_records(run)
@@ -196,11 +386,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 2
         names.append(os.path.basename(os.path.normpath(run)) or run)
         summaries.append(summarize(records))
+        all_records.append(records)
         if bad:
             print(f"note: {run}: skipped {bad} malformed line(s)")
     if len(summaries) == 1:
-        payload = {"run": names[0], "summary": summaries[0]}
+        manifest = extract_manifest(all_records[0])
+        layers = summarize_layers(all_records[0])
+        payload = {"run": names[0], "summary": summaries[0],
+                   "manifest": manifest, "layers": layers}
         print(format_summary(names[0], summaries[0], kinds))
+        if manifest and (not kinds or "manifest" in kinds):
+            print()
+            print(format_manifest(manifest))
+        if layers and (not kinds or "layers" in kinds):
+            print()
+            print(format_layers(layers))
     else:
         diff = compare(summaries[0], summaries[1])
         payload = {

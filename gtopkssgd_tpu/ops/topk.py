@@ -180,11 +180,12 @@ def simrecall_topk_abs(x: Array, k: int,
     come from strictly lower ranks. A convergence result that survives
     this selector bounds the real approx path from below.
 
-    Determinism: the drop pattern is seeded from the DATA (a bitcast of
-    sum(x) folded into a fixed key), so identical-seed A/B runs reproduce
-    exactly, while the dropped set still varies step to step as the
-    gradient changes — mirroring how approx_max_k's misses depend on the
-    value layout. Degenerate edge: if more than `pad` of the top-k are
+    Determinism: the drop pattern is seeded from the DATA (bitcasts of
+    sum(x) AND sum(|x|) folded into a fixed key — the second statistic
+    breaks the sign-symmetric collisions the first is blind to), so
+    identical-seed A/B runs reproduce exactly, while the dropped set
+    still varies step to step as the gradient changes — mirroring how
+    approx_max_k's misses depend on the value layout. Degenerate edge: if more than `pad` of the top-k are
     dropped, the tail of the result re-admits dropped elements (sorted
     after the backfill ranks) — slightly less pessimistic there, and only
     relevant at k below ~100 where pad saturates its floor.
@@ -197,6 +198,15 @@ def simrecall_topk_abs(x: Array, k: int,
         jax.random.PRNGKey(0x51AEC),
         lax.bitcast_convert_type(
             jnp.sum(x, dtype=jnp.float32), jnp.int32),
+    )
+    # Second statistic: sum(x) alone is blind to sign-symmetric changes
+    # (any rearrangement or sign flip preserving the sum replays the same
+    # drop pattern); sum(|x|) breaks that degeneracy, and cancellation-
+    # heavy gradients keep a near-constant sum(x) while |x| mass moves.
+    key = jax.random.fold_in(
+        key,
+        lax.bitcast_convert_type(
+            jnp.sum(jnp.abs(x), dtype=jnp.float32), jnp.int32),
     )
     ranks = jnp.arange(m, dtype=jnp.int32)
     dropped = (ranks < k) & (jax.random.uniform(key, (m,)) > recall)

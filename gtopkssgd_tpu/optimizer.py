@@ -55,6 +55,7 @@ from gtopkssgd_tpu.ops import (
     membership_mask,
     scatter_add_dense,
     select_topk,
+    topk_abs,
 )
 from gtopkssgd_tpu.parallel import ici_dense_psum, sparse_allreduce
 
@@ -78,9 +79,15 @@ class GTopKSGDState(NamedTuple):
     ``telemetry`` (obs subsystem, default off -> an empty pytree) carries
     the on-device training-health counters of the step that PRODUCED this
     state (obs.counters: achieved density, tau, residual norm, grad
-    norms, wire bytes) — f32 scalars, replicated under shard_map (the
-    optimizer pmeans them), so the host can read them without touching
-    per-device state."""
+    norms, wire bytes, mass-capture ratio) — f32 scalars, replicated
+    under shard_map (the optimizer pmeans them), so the host can read
+    them without touching per-device state. With ``telemetry_layers``
+    it additionally holds ``"layers"`` (obs.counters.LAYER_FIELDS as
+    f32[L] arrays, leaf order = jax.tree flatten order of the grads)
+    and ``"age"`` (per-coordinate steps-since-last-shipped, residual
+    layout, replicated by construction); with
+    ``telemetry_audit_interval`` an ``"audit_recall"`` scalar (-1 =
+    never audited)."""
 
     count: Array
     residual: Array
@@ -104,6 +111,8 @@ def gtopk_sgd(
     warmup_dense_steps: int = 0,
     momentum_correction: bool = False,
     telemetry: bool = False,
+    telemetry_layers: bool = False,
+    telemetry_audit_interval: int = 0,
     _restore_rejected_u: bool = False,
 ) -> optax.GradientTransformation:
     """Build the distributed gTop-k S-SGD gradient transformation.
@@ -199,6 +208,30 @@ def gtopk_sgd(
     fused into ops the step already runs; under a bound mesh axis they
     are pmean'd so the stored values are replicated. Off by default: the
     disabled path traces bit-identically to before the flag existed.
+
+    ``telemetry_layers`` (requires ``telemetry``) additionally resolves
+    the counters PER LAYER (obs.counters.LAYER_FIELDS — achieved
+    density, tau, pre/post grad norm, residual norm, mean residual age,
+    mass-capture ratio m(k), arXiv:1911.08772) as f32[L] arrays under
+    ``state.telemetry["layers"]``, where index i is leaf i of the grads
+    pytree in jax.tree flatten order (obs.counters.layer_names gives the
+    matching names). Layer identity is static trace-time structure, so
+    the flat modes pay a few segment reductions over the [N] vector and
+    the layerwise mode a small reduction per leaf; the
+    ``state.telemetry["age"]`` buffer (steps since each coordinate last
+    shipped, residual layout) updates from the globally-reduced update,
+    which is replicated, so it needs no collective and is excluded from
+    the pmean.
+
+    ``telemetry_audit_interval`` > 0 (requires ``telemetry``) runs an
+    exact-vs-production top-k recall audit every that-many optimizer
+    steps: the exact top-k of the error-feedback accumulator (ops.topk's
+    exact path as ground truth) is compared against the set the
+    production kernel actually selected, and the recall fraction lands
+    in ``state.telemetry["audit_recall"]`` (pmean of per-device
+    recalls). Between audits the last audited value is carried; -1 means
+    never audited (e.g. still in the dense warm-up phase). The exact
+    top-k runs under a lax.cond, so non-audit steps pay nothing.
     """
     mode = compression
     if mode not in ALL_MODES:
@@ -216,6 +249,16 @@ def gtopk_sgd(
         raise ValueError(
             f"warmup_dense_steps must be >= 0, got {warmup_dense_steps}"
         )
+    if telemetry_audit_interval < 0:
+        raise ValueError(
+            f"telemetry_audit_interval must be >= 0, got "
+            f"{telemetry_audit_interval}"
+        )
+    if (telemetry_layers or telemetry_audit_interval) and not telemetry:
+        raise ValueError(
+            "telemetry_layers / telemetry_audit_interval extend the "
+            "telemetry counters; they require telemetry=True")
+    audit = telemetry_audit_interval > 0
     if nesterov and not momentum:
         # torch.optim.SGD raises here too; silently running plain SGD while
         # the user believes Nesterov is active would be worse.
@@ -289,6 +332,15 @@ def gtopk_sgd(
             )
         return p
 
+    def _init_telemetry(params):
+        tel = obs_counters.zero_telemetry()
+        if telemetry_layers:
+            tel.update(obs_counters.zero_layer_telemetry(
+                obs_counters.layer_sizes(params), per_leaf_age=layerwise))
+        if audit:
+            tel["audit_recall"] = jnp.float32(-1.0)
+        return tel
+
     def init_fn(params) -> GTopKSGDState:
         if layerwise:
             residual = tuple(
@@ -307,16 +359,23 @@ def gtopk_sgd(
             count=jnp.zeros((), jnp.int32),
             residual=residual,
             inner=inner.init(params),
-            telemetry=obs_counters.zero_telemetry() if telemetry else (),
+            telemetry=_init_telemetry(params) if telemetry else (),
         )
 
     def _finish_telemetry(tel, p):
-        """pmean the per-device scalars when a mesh axis is bound so the
-        stored telemetry is replicated (out_specs P() in the trainer);
-        per-device quantities (residual norm, sent count) become axis
-        means — the aggregate a dashboard wants anyway."""
+        """pmean the per-device scalars (and [L] layer stats) when a mesh
+        axis is bound so the stored telemetry is replicated (out_specs
+        P() in the trainer); per-device quantities (residual norm, sent
+        count) become axis means — the aggregate a dashboard wants
+        anyway. The "age" buffer is EXCLUDED: it is replicated by
+        construction (derived from the globally-reduced update), and
+        pmean'ing it would spend an O(N) collective on a no-op."""
         if p > 1:
-            tel = {key: lax.pmean(v, axis_name) for key, v in tel.items()}
+            tel = {
+                key: (v if key == "age" else jax.tree.map(
+                    lambda x: lax.pmean(x, axis_name), v))
+                for key, v in tel.items()
+            }
         return tel
 
     def layerwise_update(grads, state: GTopKSGDState, params=None):
@@ -355,6 +414,21 @@ def gtopk_sgd(
             us = ()
             srcs = flats
 
+        def _audit_recall(accs, hits_fn):
+            """Sampled exact-vs-production recall: exact top-kk_total of
+            the concatenated accumulator as ground truth, compared
+            against the production selection via ``hits_fn(exact_idx) ->
+            bool[k]`` membership. The concatenation and exact top-k only
+            exist inside the cond's taken branch — non-audit steps pay
+            nothing."""
+            def _do():
+                ev, ei = topk_abs(jnp.concatenate(accs), kk_total)
+                return obs_counters.topk_recall(hits_fn(ei), ev)
+
+            return lax.cond(
+                (state.count % telemetry_audit_interval) == 0,
+                _do, lambda: jnp.float32(-1.0))
+
         def sparse_branch(srcs, res_in, us):
             accs = [s + r for s, r in zip(srcs, res_in)]
             tel = ()
@@ -369,23 +443,37 @@ def gtopk_sgd(
                 # compressor.k(n_l), so the shared helper applies
                 # unchanged leaf by leaf.
                 sel = [compressor.compress_by_threshold(a) for a in accs]
-                keeps = [keep for keep, _ in sel]
-                new_res = [r for _, r in sel]
+                keeps = [keep for keep, _, _ in sel]
+                new_res = [r for _, r, _ in sel]
                 u_out = (tuple(jnp.where(m, 0.0, u)
                                for u, m in zip(us, keeps))
                          if correction else us)
+                dense_fl = [a - r for a, r in zip(accs, new_res)]
                 if telemetry:
-                    taus = jnp.stack([
-                        obs_counters.keep_tau(m, a)
-                        for m, a in zip(keeps, accs)])
-                    any_kept = jnp.stack([jnp.any(m) for m in keeps])
-                    tel = (jnp.where(jnp.any(any_kept),
-                                     jnp.min(jnp.where(any_kept, taus,
-                                                       jnp.inf)), 0.0),
-                           sum(jnp.sum(m.astype(jnp.float32))
-                               for m in keeps))
-                return (([a - r for a, r in zip(accs, new_res)],
-                         tuple(new_res), u_out) + tel)
+                    # Whole-model tau from the per-leaf kept-taus the
+                    # compressor already reduced (a leaf with a nonempty
+                    # keep set always has tau > 0 — zeros never pass).
+                    taus = jnp.stack([t for _, _, t in sel])
+                    kept = taus > 0
+                    tel = {
+                        "tau": jnp.where(
+                            jnp.any(kept),
+                            jnp.min(jnp.where(kept, taus, jnp.inf)), 0.0),
+                        "sent": sum(jnp.sum(m.astype(jnp.float32))
+                                    for m in keeps),
+                        "m_k": obs_counters.mass_ratio(accs, dense_fl),
+                    }
+                    if telemetry_layers:
+                        tel["lsel"], _ = (
+                            obs_counters.leafwise_selection_stats(
+                                accs, dense_fl))
+                    if audit:
+                        tel["recall"] = _audit_recall(
+                            accs,
+                            lambda ei: jnp.take(
+                                jnp.concatenate(keeps), ei, mode="clip"))
+                    tel = (tel,)
+                return (dense_fl, tuple(new_res), u_out) + tel
             sel = [select_topk(a, kl, topk_method)
                    for a, kl in zip(accs, ks)]
             idx_l = [i for _, i in sel]
@@ -435,17 +523,43 @@ def gtopk_sgd(
             dense = scatter_add_dense(n, gidx, gvals) / p
             dense_fl = [dense[o:o + s] for o, s in zip(offsets, sizes)]
             if telemetry:
-                tel = (obs_counters.selected_tau(vals),
-                       obs_counters.sent_count(vals))
+                # Selection stats describe the LOCAL selection (what this
+                # device put on the wire), matching sent_elems /
+                # achieved_density semantics; the pmean in
+                # _finish_telemetry turns them into axis means.
+                tel = {
+                    "tau": obs_counters.selected_tau(vals),
+                    "sent": obs_counters.sent_count(vals),
+                    "m_k": obs_counters.mass_ratio(accs, vals),
+                }
+                if telemetry_layers:
+                    tel["lsel"], _ = (
+                        obs_counters.leafwise_sparse_selection_stats(
+                            accs, [v for v, _ in sel]))
+                if audit:
+                    tel["recall"] = _audit_recall(
+                        accs, lambda ei: membership_mask(ei, idx))
+                tel = (tel,)
             return (dense_fl, tuple(repaired), u_out) + tel
 
         if warmup_dense_steps > 0:
             def dense_branch(srcs, res_in, us):
                 if p > 1:
                     srcs = [lax.psum(s, axis_name) / p for s in srcs]
-                # dense phase telemetry: no threshold, everything sent
-                tel = ((jnp.float32(0.0), jnp.float32(n))
-                       if telemetry else ())
+                # dense phase telemetry: no threshold, everything sent,
+                # full mass capture, nothing to audit
+                tel = ()
+                if telemetry:
+                    teld = {"tau": jnp.float32(0.0),
+                            "sent": jnp.float32(n),
+                            "m_k": jnp.float32(1.0)}
+                    if telemetry_layers:
+                        teld["lsel"], _ = (
+                            obs_counters.dense_phase_selection_stats(
+                                sizes))
+                    if audit:
+                        teld["recall"] = jnp.float32(-1.0)
+                    tel = (teld,)
                 return (srcs, res_in, us) + tel
 
             out = lax.cond(
@@ -455,7 +569,7 @@ def gtopk_sgd(
         else:
             out = sparse_branch(srcs, res_in, us)
         if telemetry:
-            dense_fl, residual, u_new, tau, sent = out
+            dense_fl, residual, u_new, btel = out
         else:
             dense_fl, residual, u_new = out
         res_struct = residual
@@ -472,8 +586,29 @@ def gtopk_sgd(
                 grad_norm_pre=obs_counters.tree_l2(flats),
                 grad_norm_post=obs_counters.tree_l2(dense_fl),
                 residual_norm=obs_counters.tree_l2(res_struct),
-                tau=tau, sent_elems=sent,
+                tau=btel["tau"], sent_elems=btel["sent"],
+                m_k=btel["m_k"],
             )
+            if telemetry_layers:
+                # Delivered = appeared in the globally-reduced update,
+                # which is replicated — so the age buffer stays
+                # replicated without a collective (see update_age).
+                age = obs_counters.update_age(
+                    state.telemetry["age"],
+                    tuple(d != 0 for d in dense_fl))
+                tel["layers"] = obs_counters.assemble_layer_telemetry(
+                    sel_stats=btel["lsel"], sizes=sizes,
+                    grad_norm_pre_l=obs_counters.leaf_l2(flats),
+                    grad_norm_post_l=obs_counters.leaf_l2(dense_fl),
+                    residual_norm_l=obs_counters.leaf_l2(res_struct),
+                    age=age)
+                tel["age"] = age
+            if audit:
+                # Carry the last audited value between audits; -1 means
+                # never audited (dense warm-up included).
+                tel["audit_recall"] = jnp.where(
+                    btel["recall"] >= 0.0, btel["recall"],
+                    state.telemetry["audit_recall"])
             tel = _finish_telemetry(tel, p)
         else:
             tel = state.telemetry
@@ -488,6 +623,13 @@ def gtopk_sgd(
             return layerwise_update(grads, state, params)
         flat, unravel = ravel_pytree(grads)
         n = flat.shape[0]
+        if telemetry_layers:
+            # Static trace-time layer structure: ravel_pytree flattens in
+            # jax.tree order, so the segment map addresses the same
+            # leaves obs_counters.layer_names reports.
+            l_sizes = obs_counters.layer_sizes(grads)
+            l_seg = obs_counters.segment_ids(l_sizes)
+            n_layers = len(l_sizes)
         if clip_grad_norm is not None:
             # Reference LSTM path: clip the raw local gradient BEFORE the
             # residual accumulate/compress (order matters for convergence).
@@ -509,14 +651,20 @@ def gtopk_sgd(
                 flat, axis_name=axis_name, axis_size=p,
                 ici_size=hier_ici_size,
             )
-        tau = sent = None
+        btel = None
         if dense_mode:
             reduced = lax.psum(flat, axis_name) if p > 1 else flat
             dense = reduced / p
             residual = state.residual
             res_struct = residual
             if telemetry:
-                tau, sent = jnp.float32(0.0), jnp.float32(n)
+                btel = {"tau": jnp.float32(0.0), "sent": jnp.float32(n),
+                        "m_k": jnp.float32(1.0)}
+                if telemetry_layers:
+                    btel["lsel"], _ = (
+                        obs_counters.dense_phase_selection_stats(l_sizes))
+                if audit:
+                    btel["recall"] = jnp.float32(-1.0)
         else:
             if correction:
                 # DGC velocity recursion on the LOCAL (or slice-summed, in
@@ -531,6 +679,21 @@ def gtopk_sgd(
 
             def sparse_branch(src, residual_in, u_in):
                 acc = compressor.accumulate(src, residual_in)
+
+                def _audit_recall(hits_fn):
+                    """Exact-vs-production recall audit (see the
+                    layerwise twin): exact top-k of acc as ground truth,
+                    ``hits_fn(exact_idx) -> bool[k]`` membership in the
+                    production selection; the exact top-k only exists
+                    inside the cond's taken branch."""
+                    def _do():
+                        ev, ei = topk_abs(acc, compressor.k(n))
+                        return obs_counters.topk_recall(hits_fn(ei), ev)
+
+                    return lax.cond(
+                        (state.count % telemetry_audit_interval) == 0,
+                        _do, lambda: jnp.float32(-1.0))
+
                 tel = ()
                 if p == 1:
                     # No collective at p=1, so nothing ever needs the
@@ -547,18 +710,46 @@ def gtopk_sgd(
                     # before/after is in the round-3 bench artifact).
                     # Masking u at the same keep-mask is exact here:
                     # every local pick is delivered at p=1.
-                    keep, residual = compressor.compress_by_threshold(acc)
+                    keep, residual, tau_th = (
+                        compressor.compress_by_threshold(acc))
                     dense = acc - residual
                     u_out = (jnp.where(keep, 0.0, u_in)
                              if correction else u_in)
                     if telemetry:
-                        tel = (obs_counters.keep_tau(keep, acc),
-                               jnp.sum(keep.astype(jnp.float32)))
+                        tel = {
+                            "tau": tau_th,
+                            "sent": jnp.sum(keep.astype(jnp.float32)),
+                            "m_k": obs_counters.mass_ratio(acc, dense),
+                        }
+                        if telemetry_layers:
+                            tel["lsel"], _ = (
+                                obs_counters.selection_layer_stats(
+                                    acc, dense, l_seg, n_layers))
+                        if audit:
+                            tel["recall"] = _audit_recall(
+                                lambda ei: jnp.take(
+                                    keep, ei, mode="clip"))
+                        tel = (tel,)
                 else:
                     vals, idx, residual = compressor.compress(acc)
                     if telemetry:
-                        tel = (obs_counters.selected_tau(vals),
-                               obs_counters.sent_count(vals))
+                        # Selection stats describe the LOCAL selection
+                        # (what this device put on the wire); the pmean
+                        # in _finish_telemetry turns them into axis
+                        # means.
+                        tel = {
+                            "tau": obs_counters.selected_tau(vals),
+                            "sent": obs_counters.sent_count(vals),
+                            "m_k": obs_counters.mass_ratio(acc, vals),
+                        }
+                        if telemetry_layers:
+                            tel["lsel"], _ = (
+                                obs_counters.sparse_selection_layer_stats(
+                                    acc, vals, idx, l_seg, n_layers))
+                        if audit:
+                            tel["recall"] = _audit_recall(
+                                lambda ei: membership_mask(ei, idx))
+                        tel = (tel,)
                     # Momentum factor masking: a DELIVERED coordinate's
                     # velocity restarts (its momentum was consumed);
                     # without this the same mass re-sends for ~1/momentum
@@ -610,9 +801,20 @@ def gtopk_sgd(
                     # gradient (mean is linear in u), and u is NOT masked
                     # (nothing was transmitted sparsely).
                     scale = p * (hier_ici_size if (hier and p > 1) else 1)
-                    # dense phase telemetry: no threshold, everything sent
-                    tel = ((jnp.float32(0.0), jnp.float32(n))
-                           if telemetry else ())
+                    # dense phase telemetry: no threshold, everything
+                    # sent, full mass capture, nothing to audit
+                    tel = ()
+                    if telemetry:
+                        teld = {"tau": jnp.float32(0.0),
+                                "sent": jnp.float32(n),
+                                "m_k": jnp.float32(1.0)}
+                        if telemetry_layers:
+                            teld["lsel"], _ = (
+                                obs_counters.dense_phase_selection_stats(
+                                    l_sizes))
+                        if audit:
+                            teld["recall"] = jnp.float32(-1.0)
+                        tel = (teld,)
                     return (reduced / scale, residual_in, u_in) + tel
 
                 out = lax.cond(
@@ -622,7 +824,7 @@ def gtopk_sgd(
             else:
                 out = sparse_branch(src, res_in, u)
             if telemetry:
-                dense, residual, u_new, tau, sent = out
+                dense, residual, u_new, btel = out
             else:
                 dense, residual, u_new = out
             res_struct = residual
@@ -638,8 +840,33 @@ def gtopk_sgd(
                 grad_norm_pre=obs_counters.tree_l2(flat),
                 grad_norm_post=obs_counters.tree_l2(dense),
                 residual_norm=obs_counters.tree_l2(res_struct),
-                tau=tau, sent_elems=sent,
+                tau=btel["tau"], sent_elems=btel["sent"],
+                m_k=btel["m_k"],
             )
+            if telemetry_layers:
+                # Delivered = appeared in the globally-reduced update,
+                # which is replicated — so the age buffer stays
+                # replicated without a collective (see update_age).
+                age = obs_counters.update_age(
+                    state.telemetry["age"], dense != 0)
+                tel["layers"] = obs_counters.assemble_layer_telemetry(
+                    sel_stats=btel["lsel"], sizes=l_sizes,
+                    grad_norm_pre_l=obs_counters.seg_l2(
+                        flat, l_seg, n_layers),
+                    grad_norm_post_l=obs_counters.seg_l2(
+                        dense, l_seg, n_layers),
+                    residual_norm_l=(
+                        jnp.zeros((n_layers,), jnp.float32)
+                        if dense_mode else
+                        obs_counters.seg_l2(res_struct, l_seg, n_layers)),
+                    age=age, seg=l_seg)
+                tel["age"] = age
+            if audit:
+                # Carry the last audited value between audits; -1 means
+                # never audited (dense warm-up / dense mode included).
+                tel["audit_recall"] = jnp.where(
+                    btel["recall"] >= 0.0, btel["recall"],
+                    state.telemetry["audit_recall"])
             tel = _finish_telemetry(tel, p)
         else:
             tel = state.telemetry

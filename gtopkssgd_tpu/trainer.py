@@ -46,7 +46,8 @@ from gtopkssgd_tpu.optimizer import (
     expand_residual_per_device,
     gtopk_sgd,
 )
-from gtopkssgd_tpu.obs import StallWatchdog, Tracer
+from gtopkssgd_tpu.obs import StallWatchdog, Tracer, layer_names
+from gtopkssgd_tpu.obs.manifest import run_manifest
 from gtopkssgd_tpu.obs.watchdog import _default_on_stall
 from gtopkssgd_tpu.parallel import make_mesh
 from gtopkssgd_tpu.utils import (
@@ -122,6 +123,25 @@ class TrainConfig:
                                    # keep async dispatch overlap on real
                                    # accelerators (CPU-mesh runs are
                                    # synchronous anyway)
+    obs_layers: bool = False       # per-layer compression-quality
+                                   # telemetry (obs.counters.LAYER_FIELDS:
+                                   # density, tau, grad/residual norms,
+                                   # mean residual age, mass-capture
+                                   # m(k)), logged as one "layers" record
+                                   # per layer per obs step. Opt-in: it
+                                   # adds [L]-sized state (a treedef
+                                   # change checkpoints from default runs
+                                   # would not restore into) and a few
+                                   # segment reductions to the step.
+                                   # Requires obs_counters.
+    obs_audit_interval: int = 0    # every N optimizer steps, audit the
+                                   # production top-k selection against
+                                   # the exact top-k of the accumulator
+                                   # (ops.topk exact path as ground
+                                   # truth); recall lands in the "obs"
+                                   # record's audit_recall (-1 = never
+                                   # audited). 0 disables. Requires
+                                   # obs_counters.
     obs_watchdog: float = 0.0      # seconds a dispatched step may go
                                    # without host-visible progress before
                                    # the stall watchdog dumps a diagnostic
@@ -290,8 +310,22 @@ class Trainer:
             momentum_correction=cfg.momentum_correction,
             _restore_rejected_u=cfg.restore_rejected_u,
             telemetry=cfg.obs_counters,
+            telemetry_layers=cfg.obs_layers,
+            telemetry_audit_interval=cfg.obs_audit_interval,
         )
         self.state, self.carry = self._init_state()
+        # Layer-name column for "layers" records: index i of every
+        # telemetry [L] array is leaf i of the params pytree in jax.tree
+        # flatten order — the same order the optimizer's segment map uses.
+        self._layer_names = (
+            layer_names(self.state.params) if cfg.obs_layers else ())
+        # Run-manifest header: first record of metrics.jsonl, so the file
+        # is self-describing (config hash + resolved headline flags, mesh,
+        # jax/backend versions, git sha). MetricsLogger is rank-0-only,
+        # matching every other record kind.
+        self.metrics.log("manifest", **run_manifest(
+            cfg, mesh=self.mesh, num_params=self.num_params,
+            steps_per_epoch=self.steps_per_epoch))
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
         # Checkpoints: orbax save/restore of the live sharded state; on
@@ -819,9 +853,25 @@ class Trainer:
                     tel = self.state.opt_state.telemetry
                     if tel:
                         with self.tracer.span("obs_read"):
+                            # Scalar counters -> one "obs" record; the
+                            # per-layer [L] arrays -> one "layers" record
+                            # per layer; the [N] age buffer stays on
+                            # device (its per-layer mean is already in
+                            # the layers record).
                             self.metrics.log("obs", step=step, **{
                                 k: float(v) for k, v in tel.items()
+                                if k not in ("layers", "age")
                             })
+                            lay = tel.get("layers")
+                            if lay is not None:
+                                cols = {f: np.asarray(v)
+                                        for f, v in lay.items()}
+                                for i, lname in enumerate(
+                                        self._layer_names):
+                                    self.metrics.log(
+                                        "layers", step=step, layer=lname,
+                                        **{f: float(c[i])
+                                           for f, c in cols.items()})
                         synced = True
                 # With spd > 1 a dispatch may jump over the exact
                 # boundary; log when any step inside it crossed one.

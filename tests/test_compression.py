@@ -98,8 +98,10 @@ def test_compress_by_threshold_matches_exact_topk_partition(rng):
     n = 257
     comp = TopKCompressor(density=0.05, method="exact")
     acc = jnp.asarray(rng.standard_normal(n).astype(np.float32))
-    keep, res = comp.compress_by_threshold(acc)
+    keep, res, tau = comp.compress_by_threshold(acc)
     vals, idx, res_idx_form = comp.compress(acc)
+    # Reported tau is the smallest kept magnitude.
+    assert float(tau) == float(np.abs(np.asarray(vals)).min())
     # Same selected set (random floats: ties have measure zero).
     mask = np.zeros(n, bool)
     mask[np.asarray(idx)] = True
@@ -115,7 +117,8 @@ def test_compress_by_threshold_ties_all_pass():
     partition invariant still holds exactly."""
     acc = jnp.asarray([3.0, -3.0, 3.0, 1.0, -1.0, 0.5] + [0.0] * 10)
     comp = TopKCompressor(density=2 / 16, method="exact")  # k = 2
-    keep, res = comp.compress_by_threshold(acc)
+    keep, res, tau = comp.compress_by_threshold(acc)
+    assert float(tau) == 3.0
     k = np.asarray(keep)
     assert k[:3].all() and not k[3:].any()  # all three |3.0| ties pass
     assert int(k.sum()) == 3 > comp.k(16)
@@ -133,7 +136,10 @@ def test_compress_by_threshold_tau_zero_keeps_only_nonzeros():
     n = 64
     comp = TopKCompressor(density=8 / 64, method="exact")  # k = 8
     acc = jnp.zeros(n).at[3].set(2.0).at[17].set(-1.0)  # 2 nonzeros < k
-    keep, res = comp.compress_by_threshold(acc)
+    keep, res, tau = comp.compress_by_threshold(acc)
+    # tau follows the kept set (smallest kept magnitude), not the kernel's
+    # zero-padded report.
+    assert float(tau) == 1.0
     k = np.asarray(keep)
     assert int(k.sum()) == 2 and k[3] and k[17]
     np.testing.assert_array_equal(
@@ -148,7 +154,7 @@ def test_compress_by_threshold_superset_of_kernel_selection(rng):
     n = 4096
     comp = TopKCompressor(density=0.01, method="blockwise")
     acc = jnp.asarray(rng.standard_normal(n).astype(np.float32))
-    keep, _ = comp.compress_by_threshold(acc)
+    keep, _, _ = comp.compress_by_threshold(acc)
     _, idx = __import__("gtopkssgd_tpu.ops", fromlist=["select_topk"]).select_topk(
         acc, comp.k(n), comp.method
     )

@@ -33,7 +33,11 @@ def test_convergence_runner_end_to_end(tmp_path, monkeypatch):
     for s in report["modes"]:
         assert "final_loss" in s and "val_top1" in s
         assert "final_loss_vs_dense" in s
-    curve = [r for r in rows[:-1] if r.get("kind") != "summary"]
+    # First row is the run-manifest provenance header (same schema as the
+    # metrics.jsonl header); curve rows are the untagged ones.
+    assert rows[0].get("kind") == "manifest" and "config_hash" in rows[0]
+    curve = [r for r in rows[:-1]
+             if r.get("kind") not in ("summary", "manifest")]
     assert {r["step"] for r in curve if r["mode"] == "dense"} == {2, 4}
 
 

@@ -185,11 +185,22 @@ def test_layerwise_warmup_phase_bit_equals_dense():
     for i in range(3):
         p_lw, s_lw = step_lw(p_lw, s_lw, grads)
         p_d, s_d = step_d(p_d, s_d, grads)
-        same = all(
-            np.array_equal(np.asarray(a), np.asarray(b))
+        # Warmup steps compute the same math but not always the same BITS:
+        # once the momentum trace is nonzero (step >= 1), XLA:CPU may
+        # contract mu*trace + g into an FMA in one program and not the
+        # other (the layerwise program carries a live lax.cond sparse
+        # branch, so fusion decisions differ), a 1-ULP divergence
+        # (observed 7.5e-9 on f32 params). So: warmup agrees to ULP-scale
+        # tolerance, the first sparse step diverges by orders of
+        # magnitude more.
+        diff = max(
+            float(np.abs(np.asarray(a) - np.asarray(b)).max())
             for a, b in zip(jax.tree.leaves(p_lw), jax.tree.leaves(p_d))
         )
-        assert same == (i < 2), f"step {i}: warmup phase mismatch"
+        if i < 2:
+            assert diff <= 1e-6, f"warmup step {i}: diff {diff}"
+        else:
+            assert diff > 1e-3, f"step {i}: sparse phase did not engage"
 
 
 def test_layerwise_lstm_clip_before_compress_trains():

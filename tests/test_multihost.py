@@ -38,6 +38,19 @@ except Exception as e:  # unsupported build -> tell the parent to skip
     print("DISTRIBUTED-UNSUPPORTED:", e)
     raise SystemExit(99)
 assert jax.device_count() == 2 and jax.local_device_count() == 1
+# initialize() succeeding only proves the COORDINATION service works; the
+# pinned jaxlib CPU wheel can still lack cross-process XLA computations
+# ("Multiprocess computations aren't implemented on the CPU backend",
+# raised from the first collective — observed from orbax's directory-sync
+# broadcast inside Trainer.__init__). Probe one tiny collective up front
+# so unsupported builds hit the parent's skip path instead of failing
+# deep inside training.
+try:
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("capability probe")
+except Exception as e:
+    print("DISTRIBUTED-UNSUPPORTED:", e)
+    raise SystemExit(99)
 import numpy as np
 from gtopkssgd_tpu.trainer import TrainConfig, Trainer
 

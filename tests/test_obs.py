@@ -410,6 +410,13 @@ def test_trainer_emits_obs_records_and_report_reads_them(tmp_path):
             out_dir=out)) as t:
         t.train(2)
     recs = [json.loads(l) for l in open(os.path.join(out, "metrics.jsonl"))]
+    # provenance header is the FIRST record of every metrics.jsonl
+    man = recs[0]
+    assert man["kind"] == "manifest"
+    assert man["compression"] == "gtopk"
+    assert man["mesh_shape"] == {"dp": 1}
+    assert man["jax_version"] == jax.__version__
+    assert "config_hash" in man and "git_sha" in man
     obs = [r for r in recs if r["kind"] == "obs"]
     assert len(obs) == 2                     # obs_interval=1 -> per step
     for r in obs:
@@ -421,3 +428,221 @@ def test_trainer_emits_obs_records_and_report_reads_them(tmp_path):
     # the report CLI aggregates what the trainer wrote
     summary = obs_report.summarize(recs)
     assert summary["obs"]["achieved_density"]["count"] == 2
+
+
+# ------------------------------------------------- per-layer telemetry
+
+def _layer_tel(state):
+    return {f: np.asarray(v) for f, v in state.telemetry["layers"].items()}
+
+
+@pytest.mark.parametrize(
+    "mode", ["gtopk", "allgather", "gtopk_hier", "gtopk_layerwise"])
+def test_per_layer_telemetry_sparse_modes(mode):
+    params = _tiny_params()
+    grads = _tiny_grads(params)
+    rho = 0.05
+    tx = gtopk_sgd(0.1, compression=mode, density=rho, axis_name=None,
+                   telemetry=True, telemetry_layers=True)
+    state = tx.init(params)
+    sizes = np.array([x.size for x in jax.tree.leaves(params)])
+    lay = _layer_tel(state)
+    assert set(lay) == set(obs_counters.LAYER_FIELDS)
+    assert all(v.shape == (len(sizes),) for v in lay.values())
+    tel_def = jax.tree.structure(state.telemetry)
+
+    _, state = jax.jit(tx.update)(grads, state, params)
+    lay = _layer_tel(state)
+    # per-layer sent counts reassemble the whole-model counter exactly
+    sent = lay["density"] * sizes
+    assert np.allclose(sent.sum(), float(state.telemetry["sent_elems"]),
+                       atol=1.0)
+    assert (lay["grad_norm_pre"] > 0).all()
+    # flat modes may legitimately starve a small layer (all its coords
+    # below the global tau -> m_k 0); mass ratios stay in [0, 1] and at
+    # least one layer captures mass
+    assert ((lay["m_k"] >= 0) & (lay["m_k"] <= 1 + 1e-6)).all()
+    assert lay["m_k"].max() > 0
+    # the whole-model mass ratio is an acc-mass-weighted mean of the
+    # per-layer ones, so it must land inside their range
+    m = float(state.telemetry["m_k"])
+    assert lay["m_k"].min() - 1e-6 <= m <= lay["m_k"].max() + 1e-6
+    # treedef is stable across steps (lax.cond/scan compatibility)
+    _, state = jax.jit(tx.update)(grads, state, params)
+    assert jax.tree.structure(state.telemetry) == tel_def
+
+
+def test_per_layer_telemetry_dense_noop():
+    params = _tiny_params()
+    grads = _tiny_grads(params)   # strictly nonzero -> every coord ships
+    tx = gtopk_sgd(0.1, compression="dense", axis_name=None,
+                   telemetry=True, telemetry_layers=True)
+    state = tx.init(params)
+    _, state = jax.jit(tx.update)(grads, state, params)
+    lay = _layer_tel(state)
+    assert np.allclose(lay["density"], 1.0)
+    assert np.allclose(lay["m_k"], 1.0)
+    assert np.allclose(lay["tau"], 0.0)
+    assert np.allclose(lay["residual_norm"], 0.0)  # no error feedback
+    assert np.allclose(lay["residual_age"], 0.0)   # everything delivered
+
+
+def test_residual_age_monotonic():
+    params = _tiny_params()
+    grads = _tiny_grads(params)
+    tx = gtopk_sgd(0.1, compression="gtopk", density=0.05, axis_name=None,
+                   telemetry=True, telemetry_layers=True)
+    state = tx.init(params)
+    ages = [np.asarray(state.telemetry["age"])]
+    step = jax.jit(tx.update)
+    for _ in range(3):
+        _, state = step(grads, state, params)
+        ages.append(np.asarray(state.telemetry["age"]))
+    for i, (prev, cur) in enumerate(zip(ages, ages[1:]), start=1):
+        # every coordinate either shipped (age resets to 0) or aged by 1
+        assert np.all((cur == 0) | (cur == prev + 1))
+        assert cur.max() <= i
+    # constant grads + error feedback: the small-magnitude tail keeps
+    # losing the selection, so SOME coordinate is older than one step
+    assert ages[-1].max() >= 2
+    # and the per-layer mean age reported matches the raw buffer
+    lay = _layer_tel(state)
+    off, means = 0, []
+    for x in jax.tree.leaves(params):
+        means.append(ages[-1][off:off + x.size].mean())
+        off += x.size
+    assert np.allclose(lay["residual_age"], means, rtol=1e-5)
+
+
+def test_recall_audit_sampling():
+    params = _tiny_params()
+    grads = _tiny_grads(params)
+    tx = gtopk_sgd(0.1, compression="gtopk", density=0.05, axis_name=None,
+                   telemetry=True, telemetry_audit_interval=2)
+    state = tx.init(params)
+    assert float(state.telemetry["audit_recall"]) == -1.0  # never audited
+    step = jax.jit(tx.update)
+    _, state = step(grads, state, params)      # count=0 -> audited
+    r1 = float(state.telemetry["audit_recall"])
+    # exact threshold selection on all-distinct magnitudes IS the top-k
+    assert r1 == pytest.approx(1.0)
+    _, state = step(grads, state, params)      # count=1 -> carries value
+    assert float(state.telemetry["audit_recall"]) == pytest.approx(r1)
+
+
+def test_audit_flags_require_telemetry():
+    with pytest.raises(ValueError):
+        gtopk_sgd(0.1, compression="gtopk", density=0.05, axis_name=None,
+                  telemetry_layers=True)
+    with pytest.raises(ValueError):
+        gtopk_sgd(0.1, compression="gtopk", density=0.05, axis_name=None,
+                  telemetry_audit_interval=2)
+
+
+# ------------------------------------------------------------- manifest
+
+def test_manifest_roundtrip_and_hash_stability(tmp_path):
+    from gtopkssgd_tpu.obs.manifest import config_hash, git_sha, run_manifest
+
+    cfg = {"dnn": "resnet20", "density": 0.01, "nworkers": 2,
+           "batch_size": 4, "seed": 42, "compression": "gtopk"}
+    man = run_manifest(cfg, extra_field="x")
+    # json round-trip (what MetricsLogger does) preserves everything
+    back = json.loads(json.dumps(man))
+    assert back == man
+    assert back["config_hash"] == config_hash(cfg)
+    assert back["extra_field"] == "x"
+    for key in ("dnn", "density", "nworkers", "batch_size", "seed"):
+        assert back[key] == cfg[key]
+    # hash is insertion-order independent and value sensitive
+    assert config_hash(dict(reversed(list(cfg.items())))) == config_hash(cfg)
+    assert config_hash({**cfg, "density": 0.02}) != config_hash(cfg)
+    sha = git_sha()
+    assert sha is None or isinstance(sha, str)
+
+
+# ------------------------------------------------------- report gate
+
+def _synthetic_run(tmp_path, sent=100.0):
+    run = tmp_path / "run"
+    run.mkdir(exist_ok=True)
+    recs = [
+        {"kind": "manifest", "compression": "gtopk", "nworkers": 2},
+        {"kind": "obs", "step": 1, "sent_elems": sent, "tau": 0.5},
+        {"kind": "obs", "step": 2, "sent_elems": sent, "tau": 0.7},
+        {"kind": "layers", "step": 2, "layer": "w", "density": 0.05},
+        {"kind": "layers", "step": 2, "layer": "b", "density": 0.10},
+    ]
+    with open(run / "metrics.jsonl", "w") as fh:
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+    return str(run)
+
+
+def _baseline(tmp_path, **overrides):
+    base = {
+        "manifest": {"compression": "gtopk"},
+        "checks": [
+            {"kind": "obs", "field": "sent_elems", "stat": "mean",
+             "expect": 100.0, "rtol": 0.05},
+            {"kind": "obs", "field": "tau", "stat": "last",
+             "expect": 0.7, "atol": 0.01},
+            {"kind": "layers", "layer": "w", "field": "density",
+             "stat": "mean", "expect": 0.05, "rtol": 0.1},
+        ],
+    }
+    base.update(overrides)
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps(base))
+    return str(path)
+
+
+def test_report_gate_passes_within_tolerance(tmp_path):
+    run = _synthetic_run(tmp_path)
+    assert obs_report.run_gate(run, _baseline(tmp_path)) == 0
+
+
+def test_report_gate_fails_on_drift(tmp_path):
+    run = _synthetic_run(tmp_path, sent=120.0)   # > 5% off the baseline
+    assert obs_report.run_gate(run, _baseline(tmp_path)) == 1
+
+
+def test_report_gate_fails_on_missing_field_and_manifest(tmp_path):
+    run = _synthetic_run(tmp_path)
+    base = _baseline(tmp_path, checks=[
+        {"kind": "obs", "field": "vanished", "expect": 1.0, "rtol": 0.5}])
+    assert obs_report.run_gate(run, base) == 1     # silently-gone counter
+    base = _baseline(tmp_path, manifest={"compression": "dense"})
+    assert obs_report.run_gate(run, base) == 1     # provenance mismatch
+
+
+def test_report_gate_usage_errors(tmp_path):
+    run = _synthetic_run(tmp_path)
+    assert obs_report.run_gate(run, str(tmp_path / "nope.json")) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"checks": []}))
+    assert obs_report.run_gate(run, str(bad)) == 2
+
+
+def test_report_gate_write_restamps_expectations(tmp_path):
+    run = _synthetic_run(tmp_path, sent=120.0)
+    base = _baseline(tmp_path)
+    out = str(tmp_path / "new_baseline.json")
+    assert obs_report.run_gate(run, base, write=out) == 1
+    regen = json.loads(open(out).read())
+    by_field = {c["field"]: c for c in regen["checks"]}
+    assert by_field["sent_elems"]["expect"] == pytest.approx(120.0)
+    assert by_field["sent_elems"]["rtol"] == 0.05   # spec preserved
+    assert obs_report.run_gate(run, out) == 0       # regenerated -> green
+
+
+def test_gate_smoke_matches_committed_baseline(tmp_path):
+    """The tier-1 drift gate: the canonical tiny gtopk_layerwise run must
+    stay inside the committed baseline's tolerances. If an INTENTIONAL
+    change moves a counter, regenerate with
+    `python benchmarks/obs_gate_smoke.py --write-baseline` in the same
+    commit."""
+    from benchmarks.obs_gate_smoke import BASELINE, run_smoke
+
+    out = run_smoke(str(tmp_path / "run"))
+    assert obs_report.run_gate(out, BASELINE) == 0
