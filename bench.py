@@ -163,6 +163,13 @@ def main():
                          "arm (the measured best cold-start config; "
                          "dense baseline arm is unaffected — it is "
                          "classic momentum already)")
+    ap.add_argument("--attr-trace", default=None, metavar="DIR",
+                    help="after the timed windows, re-run the headline "
+                         "sparse mode under the profiler (Python tracer "
+                         "off — obs.trace_attr.capture) and fold the "
+                         "paper's T_compute/T_select/T_comm fractions "
+                         "into the output JSON; the raw trace stays in "
+                         "DIR for TensorBoard/Perfetto")
     ap.add_argument("--compression", default="auto",
                     help="sparse mode to benchmark against the dense "
                          "baseline (gtopk | gtopk_layerwise | allgather); "
@@ -201,6 +208,18 @@ def main():
         gtopk = measure_throughput(cfg, mode, args.density)
         alt = {}
     dense = measure_throughput(cfg, "dense", 1.0)
+    attr = {}
+    if args.attr_trace:
+        # Everything is jit-cached by the measurements above, so the
+        # traced window is pure execution — exactly what attribution
+        # wants on the trace.
+        from gtopkssgd_tpu.obs.trace_attr import attribute, capture
+
+        with capture(args.attr_trace):
+            measure_throughput(cfg, mode, args.density)
+        rec = attribute(args.attr_trace, mode=mode)
+        attr = {f"attr_{k}": rec[k] for k in
+                ("source", "frac_compute", "frac_select", "frac_comm")}
     p = jax.device_count()
 
     def _r(v, nd=4):
@@ -217,6 +236,7 @@ def main():
             / dense["images_per_sec_per_chip"], 4
         ),
         **alt,
+        **attr,
         "dense_images_per_sec_per_chip": round(
             dense["images_per_sec_per_chip"], 2),
         "gtopk_step_ms": round(gtopk["sec_per_step"] * 1e3, 3),

@@ -63,11 +63,29 @@ def smoke_config(out_dir: str):
 
 
 def run_smoke(out_dir: str) -> str:
-    """Train the canonical run; returns the run dir (metrics.jsonl inside)."""
+    """Train the canonical run; returns the run dir (metrics.jsonl inside).
+
+    After the baseline steps, two more run under the profiler
+    (obs.trace_attr.capture — Python tracer off, so op events survive)
+    and the paper's T_compute/T_select/T_comm split of that trace is
+    logged as an "attr" record, putting the decomposition itself under
+    the drift gate's frac checks."""
+    from gtopkssgd_tpu.obs.trace_attr import attribute, capture
     from gtopkssgd_tpu.trainer import Trainer
 
-    with Trainer(smoke_config(out_dir)) as t:
+    cfg = smoke_config(out_dir)
+    with Trainer(cfg) as t:
         t.train(SMOKE_STEPS)
+        trace_dir = os.path.join(out_dir, "trace")
+        try:
+            with capture(trace_dir):
+                t.train(2)
+            rec = attribute(trace_dir, mode=cfg.compression)
+        except Exception as e:  # platform without usable op traces
+            t.metrics.log("attr_error", error=str(e)[:200])
+        else:
+            t.metrics.log("attr", flush=True, **{
+                k: v for k, v in rec.items() if v is not None})
     return out_dir
 
 

@@ -19,16 +19,20 @@ and leaves the raw trace under --trace-dir for TensorBoard.
 from __future__ import annotations
 
 import argparse
-import collections
-import glob
-import gzip
 import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from gtopkssgd_tpu.obs.trace_attr import attribute, format_attr, op_ranking
+
 RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+
+# The op-ranking parser this tool grew up around now lives in
+# obs.trace_attr (shared with the gate smoke, bench.py --attr-trace, and
+# the report CLI); the alias keeps the historical entry point importable.
+parse_trace = op_ranking
 
 
 def capture_trace(args, trace_dir: str) -> dict:
@@ -51,88 +55,6 @@ def capture_trace(args, trace_dir: str) -> dict:
         measure_throughput(cfg, args.mode,
                            1.0 if args.mode == "dense" else args.density)
     return stats
-
-
-def parse_trace(trace_dir: str, top: int = 40) -> dict:
-    """Aggregate device-lane durations from the chrome trace.
-
-    Lane layout on this platform (device pid's thread names): "Steps"
-    (one event per device program execution, numeric names), "XLA
-    Modules" (module executions), "XLA Ops" (per-op detail). MEASURED
-    LIMITATION of the tunneled axon platform: the main (shard_map'd
-    train-step) module appears ONLY in the Steps lane — the Modules/Ops
-    lanes carry just the small host-built jits (convert/threefry/...),
-    so per-op attribution inside the train step is NOT available here
-    (see benchmarks/results/profile_resnet50_*_TPU_v5_lite.json). We
-    report both: the Steps-lane execution histogram (the honest
-    device-time record) and the op table for whatever modules the
-    profiler did attribute."""
-    paths = glob.glob(os.path.join(
-        trace_dir, "**", "*.trace.json.gz"), recursive=True)
-    if not paths:
-        raise SystemExit(f"no trace found under {trace_dir}")
-    path = max(paths, key=os.path.getmtime)
-    with gzip.open(path, "rt") as fh:
-        doc = json.load(fh)
-    events = doc.get("traceEvents", [])
-    pnames = {e.get("pid"): e.get("args", {}).get("name", "")
-              for e in events if e.get("name") == "process_name"}
-    device_pids = {pid for pid, name in pnames.items()
-                   if any(t in name.lower()
-                          for t in ("tpu", "device", "xla", "/device"))}
-    tnames = {(e.get("pid"), e.get("tid")): e.get("args", {}).get("name", "")
-              for e in events if e.get("name") == "thread_name"}
-
-    def lane(e):
-        return tnames.get((e.get("pid"), e.get("tid")), "")
-
-    def device_us(e):
-        ps = e.get("args", {}).get("device_duration_ps")
-        return float(ps) / 1e6 if ps else float(e.get("dur", 0.0))
-
-    step_durs, agg, count, cat = [], collections.defaultdict(float), \
-        collections.defaultdict(int), collections.defaultdict(float)
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in device_pids:
-            continue
-        ln = lane(e)
-        if ln == "Steps":
-            step_durs.append(device_us(e))
-        elif ln == "XLA Ops":
-            a = e.get("args", {})
-            us = device_us(e)
-            agg[e.get("name", "?")] += us
-            count[e.get("name", "?")] += 1
-            cat[a.get("hlo_category", "?")] += us
-    op_total = sum(agg.values())
-    step_durs.sort(reverse=True)
-    # Histogram of program executions: the main train step dominates the
-    # tail of repeated near-identical durations.
-    buckets = collections.Counter(round(d / 1000, 1) for d in step_durs)
-    rows = sorted(agg.items(), key=lambda kv: -kv[1])[:top]
-    return {
-        "trace_file": os.path.relpath(path, trace_dir),
-        "steps_lane": {
-            "executions": len(step_durs),
-            "total_device_ms": round(sum(step_durs) / 1000, 1),
-            "largest_ms": [round(d / 1000, 2) for d in step_durs[:10]],
-            "top_duration_ms_histogram": {
-                f"{ms}ms": n for ms, n in buckets.most_common(12)
-            },
-        },
-        "attributed_op_us_total": round(op_total, 1),
-        "attribution_note": (
-            "per-op detail covers only the small helper jits on this "
-            "platform; the train-step module is visible only as Steps-"
-            "lane executions"),
-        "hlo_category_us": {k: round(v, 1) for k, v in
-                            sorted(cat.items(), key=lambda kv: -kv[1])},
-        "top_ops": [
-            {"name": n[:160], "total_us": round(us, 1), "calls": count[n],
-             "pct": round(100 * us / op_total, 2) if op_total else None}
-            for n, us in rows
-        ],
-    }
 
 
 def main():
@@ -160,6 +82,7 @@ def main():
         os.makedirs(args.trace_dir, exist_ok=True)
         stats = capture_trace(args, args.trace_dir)
     table = parse_trace(args.trace_dir, args.top)
+    attr = attribute(args.trace_dir, mode=args.mode)
     report = {
         "what": ("device-time op ranking of the benchmark step, parsed "
                  "from the jax.profiler chrome trace"),
@@ -170,6 +93,10 @@ def main():
             ("images_per_sec_per_chip", "sec_per_step", "mfu",
              "achieved_tflops_per_chip", "flops_per_step")
         } if stats else None,
+        # The paper's three-term split of the same trace (obs.trace_attr;
+        # self-time op classification, or annotation buckets on platforms
+        # that propagate them to device lanes).
+        "attr": attr,
         **table,
     }
     os.makedirs(RESULTS, exist_ok=True)
@@ -184,6 +111,7 @@ def main():
     print(json.dumps({"out": out,
                       "steps_lane": report["steps_lane"],
                       "top5": report["top_ops"][:5]}))
+    print(format_attr(attr))
 
 
 if __name__ == "__main__":

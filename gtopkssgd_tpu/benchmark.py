@@ -535,3 +535,30 @@ def _measure_breakdown_layerwise(cfg: BenchConfig, mode: str,
                      if q in ("forward_backward", "compress_per_leaf",
                               "comm", "apply"))
     return res
+
+
+def attr_from_breakdown(breakdown: Dict[str, float]) -> Dict[str, float]:
+    """The paper's three-term split from a measure_breakdown result —
+    the HOST-measured counterpart of obs.trace_attr.attribute (which
+    reads a device trace). Same record shape, so ``report attr`` and the
+    gate's frac checks consume either source: forward_backward + apply →
+    T_compute, compress(_per_leaf) → T_select, comm → T_comm. Subject to
+    the breakdown's own caveat (isolated phases; the fused step overlaps
+    them, so the split is an upper-bound decomposition)."""
+    t = {
+        "compute": (breakdown.get("forward_backward", 0.0)
+                    + breakdown.get("apply", 0.0)),
+        "select": (breakdown.get("compress", 0.0)
+                   + breakdown.get("compress_per_leaf", 0.0)),
+        "comm": breakdown.get("comm", 0.0),
+    }
+    total = sum(t.values())
+    rec: Dict[str, float] = {
+        "mode": breakdown.get("mode"),
+        "source": "host_breakdown",
+        "t_total_us": round(total * 1e6, 1),
+    }
+    for term, sec in t.items():
+        rec[f"t_{term}_us"] = round(sec * 1e6, 1)
+        rec[f"frac_{term}"] = round(sec / total, 6) if total else 0.0
+    return rec

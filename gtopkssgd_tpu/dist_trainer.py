@@ -42,6 +42,22 @@ reference's only telemetry was text logs):
                                          with a structured diagnostic (exit
                                          43) instead of hanging forever on
                                          a dead accelerator tunnel (0 = off)
+    --obs-events / --no-obs-events       online anomaly monitor over the
+                                         synced loss/telemetry (NaN/Inf
+                                         loss, EWMA loss spike, density
+                                         collapse vs rho, residual blow-up
+                                         and age runaway) emitting fsync'd
+                                         severity-tagged "event" records
+                                         (default on)
+    --obs-halt-on {error,warn}           fail fast (exit 44) when an
+                                         anomaly event of at least this
+                                         severity fires; default: record
+                                         only, never halt
+    --obs-timeline PATH                  write the host-side Chrome-trace
+                                         timeline (Tracer spans, telemetry
+                                         counter tracks, event/stall
+                                         markers) to PATH on exit — open
+                                         in chrome://tracing or Perfetto
 
 Summarize or diff the resulting metrics.jsonl with
 ``python -m gtopkssgd_tpu.obs.report <out-dir> [<other-out-dir>]``.
@@ -163,6 +179,27 @@ def build_argparser() -> argparse.ArgumentParser:
                         "visible progress before the stall watchdog dumps "
                         "a structured diagnostic and exits 43 (0 = off); "
                         "set well above log-interval * step time")
+    p.add_argument("--obs-events", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="online anomaly monitor (obs.events): NaN/Inf "
+                        "loss, EWMA loss spike, achieved-density collapse "
+                        "vs rho, residual blow-up/age runaway — each "
+                        "firing logs a severity-tagged fsync'd 'event' "
+                        "record at the obs/log sync points (no extra "
+                        "device reads)")
+    p.add_argument("--obs-halt-on", default=None,
+                   choices=["error", "warn"],
+                   help="fail fast when an anomaly event of at least this "
+                        "severity fires: the event record is flushed, "
+                        "then the run exits 44 (the stall watchdog owns "
+                        "43); default records without halting")
+    p.add_argument("--obs-timeline", default=None, metavar="PATH",
+                   help="write the host-side Chrome-trace timeline "
+                        "(obs.timeline: Tracer spans, telemetry counter "
+                        "tracks, event/stall markers) here on exit; view "
+                        "in chrome://tracing or ui.perfetto.dev. Rebuild "
+                        "one later from metrics.jsonl with 'python -m "
+                        "gtopkssgd_tpu.obs.report timeline <out-dir>'")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint from out-dir")
     p.add_argument("--multihost", action="store_true",
@@ -209,6 +246,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         obs_layers=args.obs_layers,
         obs_audit_interval=args.obs_audit_interval,
         obs_watchdog=args.obs_watchdog,
+        obs_events=args.obs_events,
+        obs_halt_on=args.obs_halt_on,
+        obs_timeline=args.obs_timeline,
         prefetch=args.prefetch,
         decode_workers=args.decode_workers,
     )
@@ -224,32 +264,44 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # program; ICI inside a slice, DCN across slices — both are just the
         # 'dp' axis to the program (reference: MPI.COMM_WORLD over ethernet).
         jax.distributed.initialize()
+    from gtopkssgd_tpu.obs.events import HALT_EXIT_CODE, AnomalyHalt
+
     with Trainer(config_from_args(args)) as trainer:
-        if args.resume:
-            restored = trainer.restore()
-            trainer.logger.info("resume: %s",
-                                "restored" if restored else "fresh")
-        if args.profile_dir:
-            # SURVEY.md §5 tracing: the reference only had host timer
-            # dicts; here a real jax.profiler device trace complements
-            # them. One dispatch first so compilation stays out of the
-            # trace; step counts round up to whole dispatches so the
-            # path composes with --steps-per-dispatch.
-            spd = trainer.cfg.steps_per_dispatch
-            warm = spd
-            traced = max(spd, -(-args.profile_steps // spd) * spd)
-            trainer.train(warm)
-            jax.profiler.start_trace(args.profile_dir)
-            trainer.train(traced)
-            jax.profiler.stop_trace()
-            trainer.logger.info("profiler: %d-step trace -> %s",
-                                traced, args.profile_dir)
-        if args.num_iters is not None:
-            stats = trainer.train(args.num_iters)
-            stats.update(trainer.test())
-        else:
-            stats = trainer.fit()
-        trainer.logger.info("done: %s", stats)
+        try:
+            return _run(args, trainer)
+        except AnomalyHalt as halt:
+            # The monitor flushed the event record before raising; this
+            # path only reports and maps to the contract exit code.
+            trainer.logger.error("anomaly halt: %s", halt)
+            return HALT_EXIT_CODE
+
+
+def _run(args: argparse.Namespace, trainer: Trainer) -> int:
+    if args.resume:
+        restored = trainer.restore()
+        trainer.logger.info("resume: %s",
+                            "restored" if restored else "fresh")
+    if args.profile_dir:
+        # SURVEY.md §5 tracing: the reference only had host timer
+        # dicts; here a real jax.profiler device trace complements
+        # them. One dispatch first so compilation stays out of the
+        # trace; step counts round up to whole dispatches so the
+        # path composes with --steps-per-dispatch.
+        spd = trainer.cfg.steps_per_dispatch
+        warm = spd
+        traced = max(spd, -(-args.profile_steps // spd) * spd)
+        trainer.train(warm)
+        jax.profiler.start_trace(args.profile_dir)
+        trainer.train(traced)
+        jax.profiler.stop_trace()
+        trainer.logger.info("profiler: %d-step trace -> %s",
+                            traced, args.profile_dir)
+    if args.num_iters is not None:
+        stats = trainer.train(args.num_iters)
+        stats.update(trainer.test())
+    else:
+        stats = trainer.fit()
+    trainer.logger.info("done: %s", stats)
     return 0
 
 

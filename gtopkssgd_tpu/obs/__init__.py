@@ -19,12 +19,30 @@ residual dynamics). This package turns the repo's scattered primitives
       dispatched step failing to become ready within a deadline (the
       BENCH_r05 dead-tunnel mode), emits a structured diagnostic and
       fails fast instead of hanging.
+  trace_attr.py — chrome-trace parser shared with benchmarks/
+      profile_step.py: buckets device-lane self times into the paper's
+      T_compute/T_select/T_comm decomposition (annotation names when the
+      platform propagates them to device lanes, an op-name classifier —
+      sort/top-k → select, collectives → comm — as the fallback), plus
+      the capture() helper that keeps op events in the trace by running
+      the profiler with the Python tracer off.
+  timeline.py — host-side Chrome-trace/Perfetto export
+      (``--obs-timeline``): every Tracer span as a duration event,
+      telemetry as counter tracks, anomaly events and watchdog stalls as
+      instant markers — one file correlating host and device phases.
+  events.py   — online anomaly monitor over the per-step telemetry:
+      NaN/Inf loss, EWMA loss spikes, achieved-density collapse vs. rho,
+      residual-norm blow-up, residual-age runaway; severity-tagged
+      "event" records (fsync'd) and optional ``--obs-halt-on``
+      fail-fast (exit 44).
   report.py   — ``python -m gtopkssgd_tpu.obs.report`` aggregates one or
       two metrics.jsonl runs into per-kind/per-metric summaries (incl.
       per-layer breakdown tables from "layers" records), a side-by-side
       regression-triage comparison, and a ``gate`` subcommand diffing a
       run against a committed baseline JSON with per-field tolerances
-      (nonzero exit on regression — the tier-1 drift gate).
+      (nonzero exit on regression — the tier-1 drift gate), and the
+      ``attr`` / ``events`` / ``timeline`` subcommands over the three
+      modules above.
   manifest.py — run-manifest header (config hash, resolved headline
       flags, mesh shape, jax/backend versions, git sha) written as the
       first record of every metrics.jsonl so runs are self-describing.
@@ -47,17 +65,34 @@ from gtopkssgd_tpu.obs.counters import (
     mass_ratio,
     selected_tau,
     sent_count,
+    telemetry_scalars,
     topk_recall,
     tree_l2,
     zero_telemetry,
 )
+from gtopkssgd_tpu.obs.events import (
+    HALT_EXIT_CODE,
+    AnomalyHalt,
+    AnomalyMonitor,
+    Thresholds,
+)
 from gtopkssgd_tpu.obs.manifest import config_hash, git_sha, run_manifest
+from gtopkssgd_tpu.obs.timeline import (
+    TimelineRecorder,
+    timeline_from_records,
+    validate_timeline,
+)
 from gtopkssgd_tpu.obs.tracing import Tracer
 from gtopkssgd_tpu.obs.watchdog import StallWatchdog
 
 __all__ = [
+    "HALT_EXIT_CODE",
     "LAYER_FIELDS",
     "TELEMETRY_FIELDS",
+    "AnomalyHalt",
+    "AnomalyMonitor",
+    "Thresholds",
+    "TimelineRecorder",
     "Tracer",
     "StallWatchdog",
     "config_hash",
@@ -69,7 +104,10 @@ __all__ = [
     "run_manifest",
     "selected_tau",
     "sent_count",
+    "telemetry_scalars",
+    "timeline_from_records",
     "topk_recall",
     "tree_l2",
+    "validate_timeline",
     "zero_telemetry",
 ]

@@ -418,3 +418,14 @@ def topk_recall(hits: Array, exact_vals: Array) -> Array:
     real = jnp.abs(exact_vals) > 0
     n_real = jnp.maximum(jnp.sum(real.astype(jnp.float32)), 1.0)
     return jnp.sum((hits & real).astype(jnp.float32)) / n_real
+
+
+def telemetry_scalars(telemetry: Dict[str, Array]) -> Dict[str, float]:
+    """Host floats of the SCALAR counters in a state's telemetry dict —
+    the per-layer "layers" sub-dict and the [N] "age" buffer excluded.
+    One sync point shared by the trainer's "obs" record and the anomaly
+    monitor, so adding a consumer never adds a device read."""
+    return {
+        key: float(val) for key, val in telemetry.items()
+        if key not in ("layers", "age")
+    }

@@ -1,0 +1,207 @@
+"""Online anomaly events: the run notices its own failures in flight.
+
+arXiv:1911.08772 ties top-k-with-error-feedback convergence to the
+residual dynamics; PRs 1–2 made those dynamics (and the achieved wire
+density) per-step telemetry, but nothing LOOKED at the stream — a NaN
+loss, a collapsed density, or a runaway residual was discovered by a
+human reading metrics.jsonl after the fact. ``AnomalyMonitor`` closes the
+loop inside the train loop, at the cadence the telemetry is already
+synced (no extra device reads):
+
+  rule                  severity  fires when
+  --------------------  --------  -------------------------------------
+  nan_loss              error     loss is NaN/Inf
+  loss_spike            warn      EWMA z-score of the loss exceeds
+                                  ``loss_spike_z`` (after warmup)
+  density_collapse      warn      achieved_density < collapse_frac * rho
+                                  (sparse modes; selection went degenerate)
+  residual_blowup       warn      residual_norm > blowup_x * its EWMA
+                                  (error feedback diverging, after warmup)
+  residual_age_runaway  warn      max per-layer mean residual age >
+                                  age_max steps (starved coordinates;
+                                  auto threshold 100/rho — uniform
+                                  rotation re-ships a coordinate every
+                                  ~1/rho steps)
+
+Each firing emits one severity-tagged ``event`` record through
+MetricsLogger with ``flush=True`` (fsync'd — a run killed one line later
+keeps its diagnosis) and an instant marker on the timeline when one is
+recording. ``halt_on`` turns detection into fail-fast: observing an event
+at (or above) that severity raises ``AnomalyHalt`` after the record is
+durably written, and dist_trainer maps it to exit code 44 (the watchdog
+owns 43).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional
+
+# Exit code for --obs-halt-on fail-fast (watchdog stalls exit 43).
+HALT_EXIT_CODE = 44
+
+_SEVERITY_RANK = {"info": 0, "warn": 1, "error": 2}
+
+
+class AnomalyHalt(RuntimeError):
+    """Raised by AnomalyMonitor.observe when an event reaches the
+    configured halt severity. Carries the triggering event record."""
+
+    def __init__(self, event: Dict[str, Any]):
+        super().__init__(
+            f"anomaly halt: {event.get('rule')} "
+            f"(severity={event.get('severity')}, step={event.get('step')}, "
+            f"value={event.get('value')})")
+        self.event = event
+
+
+@dataclasses.dataclass
+class Thresholds:
+    """Rule thresholds; defaults documented in the README's event table."""
+
+    loss_spike_z: float = 6.0        # EWMA z-score
+    loss_ewma_alpha: float = 0.1     # EWMA decay for loss mean/var
+    loss_warmup: int = 5             # observations before spike/blowup arm
+    density_collapse_frac: float = 0.1   # achieved < frac * rho
+    residual_blowup_x: float = 10.0  # residual_norm vs its EWMA
+    residual_age_max: float = 0.0    # steps; 0 = auto (100 / rho)
+
+    def age_max(self, rho: Optional[float]) -> float:
+        if self.residual_age_max > 0:
+            return self.residual_age_max
+        if rho and rho > 0:
+            return 100.0 / rho
+        return math.inf
+
+
+def _finite(x: Optional[float]) -> bool:
+    return x is not None and isinstance(x, (int, float)) and math.isfinite(x)
+
+
+class AnomalyMonitor:
+    """Stateful rule evaluator over the per-step (loss, telemetry) stream.
+
+    ``metrics`` is a MetricsLogger (or None for in-memory use);
+    ``timeline`` an optional TimelineRecorder; ``rho`` the configured
+    density for sparse modes (None disables the density/age rules);
+    ``halt_on`` one of None | "warn" | "error" — the minimum severity
+    that raises AnomalyHalt."""
+
+    def __init__(self, metrics=None, rho: Optional[float] = None,
+                 halt_on: Optional[str] = None,
+                 thresholds: Optional[Thresholds] = None,
+                 timeline=None):
+        if halt_on is not None and halt_on not in _SEVERITY_RANK:
+            raise ValueError(
+                f"halt_on={halt_on!r} must be one of "
+                f"{sorted(_SEVERITY_RANK)} or None")
+        self.metrics = metrics
+        self.timeline = timeline
+        self.rho = rho
+        self.halt_on = halt_on
+        self.th = thresholds or Thresholds()
+        self.events: List[Dict[str, Any]] = []
+        # EWMA state (loss mean/var, residual norm) + sample counts.
+        self._loss_mean: Optional[float] = None
+        self._loss_var = 0.0
+        self._loss_n = 0
+        self._res_mean: Optional[float] = None
+        self._res_n = 0
+
+    # ---------------------------------------------------------- the rules
+    def _check(self, step: int, loss: Optional[float],
+               telemetry: Optional[Dict[str, float]],
+               max_residual_age: Optional[float]) -> List[Dict[str, Any]]:
+        th = self.th
+        out: List[Dict[str, Any]] = []
+
+        def fire(rule, severity, value, threshold, message):
+            out.append({
+                "rule": rule, "severity": severity, "step": step,
+                "value": round(float(value), 6) if _finite(value) else None,
+                "threshold": (round(float(threshold), 6)
+                              if math.isfinite(threshold) else None),
+                "message": message,
+            })
+
+        if loss is not None and not _finite(loss):
+            fire("nan_loss", "error", loss, math.nan,
+                 f"non-finite loss at step {step}")
+        elif _finite(loss):
+            if (self._loss_n >= th.loss_warmup and self._loss_var > 0):
+                z = (loss - self._loss_mean) / math.sqrt(self._loss_var)
+                if z > th.loss_spike_z:
+                    fire("loss_spike", "warn", z, th.loss_spike_z,
+                         f"loss {loss:.4g} is {z:.1f} sigma above its "
+                         f"EWMA {self._loss_mean:.4g}")
+            a = th.loss_ewma_alpha
+            if self._loss_mean is None:
+                self._loss_mean = float(loss)
+            else:
+                d = float(loss) - self._loss_mean
+                self._loss_mean += a * d
+                self._loss_var = (1 - a) * (self._loss_var + a * d * d)
+            self._loss_n += 1
+
+        tel = telemetry or {}
+        dens = tel.get("achieved_density")
+        if (self.rho and _finite(dens)
+                and dens < th.density_collapse_frac * self.rho):
+            fire("density_collapse", "warn", dens,
+                 th.density_collapse_frac * self.rho,
+                 f"achieved density {dens:.3g} collapsed below "
+                 f"{th.density_collapse_frac:g} x rho={self.rho:g}")
+
+        res = tel.get("residual_norm")
+        if _finite(res):
+            if (self._res_n >= th.loss_warmup and self._res_mean
+                    and res > th.residual_blowup_x * self._res_mean):
+                fire("residual_blowup", "warn", res,
+                     th.residual_blowup_x * self._res_mean,
+                     f"residual norm {res:.4g} blew past "
+                     f"{th.residual_blowup_x:g} x EWMA "
+                     f"{self._res_mean:.4g}")
+            a = th.loss_ewma_alpha
+            self._res_mean = (float(res) if self._res_mean is None
+                              else self._res_mean
+                              + a * (float(res) - self._res_mean))
+            self._res_n += 1
+
+        age_max = th.age_max(self.rho)
+        if _finite(max_residual_age) and max_residual_age > age_max:
+            fire("residual_age_runaway", "warn", max_residual_age, age_max,
+                 f"max per-layer mean residual age {max_residual_age:.0f} "
+                 f"steps exceeds {age_max:.0f} (starved coordinates)")
+        return out
+
+    # ------------------------------------------------------------- public
+    def observe(self, step: int, loss: Optional[float] = None,
+                telemetry: Optional[Dict[str, float]] = None,
+                max_residual_age: Optional[float] = None
+                ) -> List[Dict[str, Any]]:
+        """Evaluate every rule against one step's synced scalars; emit
+        and return the fired events. Raises AnomalyHalt AFTER all records
+        are flushed when any event reaches the halt severity."""
+        fired = self._check(step, loss, telemetry, max_residual_age)
+        halting = None
+        for ev in fired:
+            self.events.append(ev)
+            if self.metrics is not None:
+                self.metrics.log("event", flush=True, **ev)
+            if self.timeline is not None:
+                self.timeline.instant(f"event:{ev['rule']}", args=ev)
+            if (self.halt_on is not None and halting is None
+                    and _SEVERITY_RANK[ev["severity"]]
+                    >= _SEVERITY_RANK[self.halt_on]):
+                halting = ev
+        if halting is not None:
+            raise AnomalyHalt(halting)
+        return fired
+
+    def summary(self) -> Dict[str, int]:
+        """{rule: count} over the monitor's lifetime (test/report aid)."""
+        out: Dict[str, int] = {}
+        for ev in self.events:
+            out[ev["rule"]] = out.get(ev["rule"], 0) + 1
+        return out

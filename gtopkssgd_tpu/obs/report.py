@@ -5,6 +5,10 @@ or gate one against a committed baseline.
     python -m gtopkssgd_tpu.obs.report <runA> <runB>    # side-by-side diff
     python -m gtopkssgd_tpu.obs.report <run> --json out.json
     python -m gtopkssgd_tpu.obs.report gate <run> --baseline base.json
+    python -m gtopkssgd_tpu.obs.report attr <run|trace> # T_compute/T_select/
+                                                        # T_comm decomposition
+    python -m gtopkssgd_tpu.obs.report events <run>     # anomaly events by rule
+    python -m gtopkssgd_tpu.obs.report timeline <run>   # rebuild timeline.json
 
 A <run> is a directory containing metrics.jsonl (what --out-dir produces)
 or a path to any .jsonl file of MetricsLogger records. Records group by
@@ -206,7 +210,10 @@ def compare(a: Dict[str, Dict[str, dict]],
         for key in fields:
             ma, mb = a[kind][key]["mean"], b[kind][key]["mean"]
             delta = mb - ma
-            pct = (delta / abs(ma) * 100.0) if ma else float("nan")
+            # A zero baseline has no meaningful relative change: record
+            # None (rendered "—"), never a `+nan%` column; the absolute
+            # delta still prints.
+            pct = (delta / abs(ma) * 100.0) if ma else None
             out[kind][key] = {"mean_a": ma, "mean_b": mb,
                               "delta": delta, "delta_pct": pct}
     return out
@@ -224,7 +231,7 @@ def format_compare(name_a: str, name_b: str,
             pct = d["delta_pct"]
             rows.append([
                 key, _fmt(d["mean_a"]), _fmt(d["mean_b"]), _fmt(d["delta"]),
-                ("nan" if pct != pct else f"{pct:+.1f}%"),
+                ("—" if pct is None or pct != pct else f"{pct:+.1f}%"),
             ])
         if rows:
             chunks.append(f"\n[{kind}]")
@@ -331,6 +338,153 @@ def run_gate(run: str, baseline_path: str,
     return 1 if failures else 0
 
 
+def _is_run(target: str) -> bool:
+    """Does the target look like a metrics run (vs. a profiler trace)?"""
+    if os.path.isdir(target):
+        return os.path.exists(os.path.join(target, "metrics.jsonl"))
+    return target.endswith(".jsonl")
+
+
+def run_attr(target: str, mode: Optional[str] = None,
+             json_out: Optional[str] = None) -> int:
+    """``attr`` subcommand: print the paper's T_compute/T_select/T_comm
+    table. The target is either a run (metrics.jsonl carrying logged
+    "attr" records — the gate smoke writes one) or a profiler trace
+    dir/file, which is parsed and attributed on the spot."""
+    from gtopkssgd_tpu.obs import trace_attr
+
+    if _is_run(target):
+        try:
+            records, bad = load_records(target)
+        except OSError as e:
+            print(f"cannot read {target}: {e}")
+            return 2
+        recs = [{k: v for k, v in r.items() if k not in _META_FIELDS}
+                for r in records if r.get("kind") == "attr"]
+        if not recs:
+            print(f"{target}: no attr records (pass a trace dir, or log "
+                  "one via obs.trace_attr.attribute)")
+            return 1
+    else:
+        try:
+            recs = [trace_attr.attribute(target, mode=mode)]
+        except (FileNotFoundError, OSError, ValueError) as e:
+            print(f"cannot attribute {target}: {e}")
+            return 2
+    for rec in recs:
+        print(trace_attr.format_attr(rec))
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump(recs if len(recs) > 1 else recs[0], fh, indent=1,
+                      sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0
+
+
+def summarize_events(records: Iterable[dict]) -> Dict[str, dict]:
+    """{rule: {severity, count, first_step, last_step, last_value,
+    threshold, last_message}} over kind=="event" records."""
+    by_rule: Dict[str, dict] = {}
+    for rec in records:
+        if rec.get("kind") != "event":
+            continue
+        rule = str(rec.get("rule", "?"))
+        r = by_rule.setdefault(rule, {
+            "severity": rec.get("severity"), "count": 0,
+            "first_step": None, "last_step": None, "last_value": None,
+            "threshold": rec.get("threshold"), "last_message": None,
+        })
+        r["count"] += 1
+        r["severity"] = rec.get("severity", r["severity"])
+        step = rec.get("step")
+        if isinstance(step, (int, float)):
+            r["first_step"] = (step if r["first_step"] is None
+                               else min(r["first_step"], step))
+            r["last_step"] = (step if r["last_step"] is None
+                              else max(r["last_step"], step))
+        r["last_value"] = rec.get("value", r["last_value"])
+        r["threshold"] = rec.get("threshold", r["threshold"])
+        r["last_message"] = rec.get("message", r["last_message"])
+    return by_rule
+
+
+def format_events(name: str, by_rule: Dict[str, dict]) -> str:
+    if not by_rule:
+        return f"events: {name}: none recorded"
+    rows = []
+    for rule in sorted(by_rule):
+        r = by_rule[rule]
+        rows.append([
+            rule, str(r["severity"]), str(r["count"]),
+            "-" if r["first_step"] is None else _fmt(r["first_step"]),
+            "-" if r["last_step"] is None else _fmt(r["last_step"]),
+            "-" if r["last_value"] is None else _fmt(r["last_value"]),
+            "-" if r["threshold"] is None else _fmt(r["threshold"]),
+        ])
+    out = [f"events: {name}",
+           _table(rows, ["rule", "severity", "count", "first_step",
+                         "last_step", "last_value", "threshold"])]
+    for rule in sorted(by_rule):
+        msg = by_rule[rule]["last_message"]
+        if msg:
+            out.append(f"  {rule}: {msg}")
+    return "\n".join(out)
+
+
+def run_events(run: str, json_out: Optional[str] = None) -> int:
+    """``events`` subcommand: summarize a run's anomaly stream per rule."""
+    try:
+        records, bad = load_records(run)
+    except OSError as e:
+        print(f"cannot read {run}: {e}")
+        return 2
+    if bad:
+        print(f"note: {run}: skipped {bad} malformed line(s)")
+    by_rule = summarize_events(records)
+    name = os.path.basename(os.path.normpath(run)) or run
+    print(format_events(name, by_rule))
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump(by_rule, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0
+
+
+def run_timeline(run: str, out: Optional[str] = None) -> int:
+    """``timeline`` subcommand: rebuild a chrome-trace timeline from a
+    run's metrics.jsonl (markers + counter tracks at recorded wall-clock
+    times), validate it, and write it next to the run."""
+    from gtopkssgd_tpu.obs.timeline import (
+        timeline_from_records,
+        validate_timeline,
+    )
+
+    try:
+        records, bad = load_records(run)
+    except OSError as e:
+        print(f"cannot read {run}: {e}")
+        return 2
+    if bad:
+        print(f"note: {run}: skipped {bad} malformed line(s)")
+    name = os.path.basename(os.path.normpath(run)) or run
+    doc = timeline_from_records(records, label=name)
+    problems = validate_timeline(doc)
+    if out is None:
+        base = run if os.path.isdir(run) else os.path.dirname(run) or "."
+        out = os.path.join(base, "timeline.json")
+    with open(out, "w") as fh:
+        json.dump(doc, fh)
+        fh.write("\n")
+    n = sum(1 for e in doc["traceEvents"] if e.get("ph") != "M")
+    print(f"timeline: {name}: {n} events -> {out}"
+          + (" (open in chrome://tracing or ui.perfetto.dev)"))
+    for p in problems:
+        print(f"invalid: {p}")
+    return 1 if problems else 0
+
+
 def build_gate_argparser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         "gtopkssgd_tpu.obs.report gate",
@@ -371,6 +525,39 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if argv and argv[0] == "gate":
         gargs = build_gate_argparser().parse_args(argv[1:])
         return run_gate(gargs.run, gargs.baseline, gargs.write)
+    if argv and argv[0] == "attr":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report attr",
+            description="Print the paper's T_compute/T_select/T_comm "
+                        "decomposition from a run's attr records or "
+                        "straight from a jax.profiler trace.")
+        ap.add_argument("target",
+                        help="an --out-dir / metrics.jsonl with attr "
+                             "records, or a profiler trace dir/file")
+        ap.add_argument("--mode", default=None,
+                        help="mode label stamped on a trace-derived record")
+        ap.add_argument("--json", dest="json_out", default=None)
+        a = ap.parse_args(argv[1:])
+        return run_attr(a.target, mode=a.mode, json_out=a.json_out)
+    if argv and argv[0] == "events":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report events",
+            description="Summarize a run's anomaly event stream per rule "
+                        "(first/last step, count, last value).")
+        ap.add_argument("run")
+        ap.add_argument("--json", dest="json_out", default=None)
+        a = ap.parse_args(argv[1:])
+        return run_events(a.run, json_out=a.json_out)
+    if argv and argv[0] == "timeline":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report timeline",
+            description="Rebuild and validate a chrome-trace timeline "
+                        "from a run's metrics.jsonl.")
+        ap.add_argument("run")
+        ap.add_argument("--out", default=None,
+                        help="output path (default: <run>/timeline.json)")
+        a = ap.parse_args(argv[1:])
+        return run_timeline(a.run, out=a.out)
     args = build_argparser().parse_args(argv)
     if len(args.runs) > 2:
         print("at most 2 runs (one to summarize, two to compare)")

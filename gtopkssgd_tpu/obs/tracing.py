@@ -37,15 +37,20 @@ class Tracer:
         metrics=None,
         enabled: bool = True,
         record_each: bool = False,
+        sink=None,
     ):
         """``metrics`` is a utils.metrics.MetricsLogger (or anything with
         ``.log(kind, **fields)``). ``record_each=True`` writes one jsonl
         record per span close — verbose; the default accumulates into
-        ``stats`` and ships means via ``flush()``."""
+        ``stats`` and ships means via ``flush()``. ``sink`` is an
+        optional callable ``(path, t0_perf_counter, dur_seconds)``
+        invoked on every span close — the timeline recorder's hook
+        (obs.timeline.TimelineRecorder.span_sink matches it)."""
         self.stats = stats or TimingStats()
         self.metrics = metrics
         self.enabled = enabled
         self.record_each = record_each
+        self.sink = sink
         self._local = threading.local()
 
     def _stack(self):
@@ -90,6 +95,8 @@ class Tracer:
                 dur = time.perf_counter() - t0
                 stack.pop()
                 self.stats.add(path, dur)
+                if self.sink is not None:
+                    self.sink(path, t0, dur)
                 if self.record_each and self.metrics is not None:
                     self.metrics.log(
                         "span", name=name, path=path, dur_s=dur, **attrs

@@ -46,7 +46,14 @@ from gtopkssgd_tpu.optimizer import (
     expand_residual_per_device,
     gtopk_sgd,
 )
-from gtopkssgd_tpu.obs import StallWatchdog, Tracer, layer_names
+from gtopkssgd_tpu.obs import (
+    AnomalyMonitor,
+    StallWatchdog,
+    TimelineRecorder,
+    Tracer,
+    layer_names,
+    telemetry_scalars,
+)
 from gtopkssgd_tpu.obs.manifest import run_manifest
 from gtopkssgd_tpu.obs.watchdog import _default_on_stall
 from gtopkssgd_tpu.parallel import make_mesh
@@ -151,6 +158,25 @@ class TrainConfig:
                                    # heartbeat fires on blocking reads
                                    # (obs/log records, the end-of-train
                                    # sync), not on async enqueues
+    obs_events: bool = True        # online anomaly monitor (obs.events)
+                                   # over the synced loss/telemetry:
+                                   # NaN/Inf loss, EWMA loss spike,
+                                   # density collapse vs rho, residual
+                                   # blow-up/age runaway — severity-
+                                   # tagged "event" records, fsync'd.
+                                   # Piggybacks on reads the loop already
+                                   # does (obs/log intervals); never adds
+                                   # a device sync.
+    obs_halt_on: Optional[str] = None  # "error" | "warn": raise
+                                   # AnomalyHalt (dist_trainer exit 44)
+                                   # when an event of at least this
+                                   # severity fires; None = record only
+    obs_timeline: Optional[str] = None  # write the host-side Chrome-
+                                   # trace timeline (obs.timeline: Tracer
+                                   # spans, telemetry counter tracks,
+                                   # event/stall markers) here on exit
+                                   # (a directory gets timeline.json
+                                   # appended); None disables
     prefetch: int = 2              # host batches assembled ahead by a
                                    # background thread (0 = synchronous;
                                    # reference C8 parity with DataLoader
@@ -252,10 +278,35 @@ class Trainer:
         self.logger = get_logger("trainer", rank=self.process_rank)
         self.metrics = MetricsLogger(cfg.out_dir, self.logger,
                                      rank=self.process_rank)
+        # Host timeline (obs.timeline): spans + telemetry tracks + event
+        # markers as one chrome-trace JSON, written on __exit__ (and
+        # best-effort on a watchdog stall). Rank 0 only, like metrics.
+        self.timeline = (
+            TimelineRecorder(rank=self.process_rank)
+            if cfg.obs_timeline and self.process_rank == 0 else None
+        )
         # Span tracer (obs.tracing): host phase timing + profiler
         # TraceAnnotations under one name. Replaces the bare StepTimer
         # (utils/timers.py keeps the primitive).
-        self.tracer = Tracer(metrics=self.metrics)
+        self.tracer = Tracer(
+            metrics=self.metrics,
+            sink=self.timeline.span_sink if self.timeline else None,
+        )
+        # Online anomaly monitor (obs.events): fed at the obs/log sync
+        # points below; density rules only make sense when a sparse mode
+        # has a configured rho.
+        from gtopkssgd_tpu.modes import DENSE_MODES
+
+        self.monitor = (
+            AnomalyMonitor(
+                metrics=self.metrics,
+                rho=(cfg.density
+                     if cfg.compression not in DENSE_MODES else None),
+                halt_on=cfg.obs_halt_on,
+                timeline=self.timeline,
+            )
+            if cfg.obs_events else None
+        )
         self.watchdog = (
             StallWatchdog(cfg.obs_watchdog,
                           on_stall=self._on_stall,
@@ -323,7 +374,7 @@ class Trainer:
         # is self-describing (config hash + resolved headline flags, mesh,
         # jax/backend versions, git sha). MetricsLogger is rank-0-only,
         # matching every other record kind.
-        self.metrics.log("manifest", **run_manifest(
+        self.metrics.log("manifest", flush=True, **run_manifest(
             cfg, mesh=self.mesh, num_params=self.num_params,
             steps_per_epoch=self.steps_per_epoch))
         self._train_step = self._build_train_step()
@@ -383,6 +434,12 @@ class Trainer:
         self.close()
         if self.watchdog is not None:
             self.watchdog.close()
+        if self.timeline is not None:
+            try:
+                path = self.timeline.write(self.cfg.obs_timeline)
+                self.logger.info("timeline -> %s", path)
+            except OSError as e:
+                self.logger.warning("timeline write failed: %s", e)
         # The metrics file outlives close() (restore() can resume a closed
         # Trainer's training); only leaving the context ends the run.
         self.metrics.close()
@@ -405,12 +462,23 @@ class Trainer:
         survives the hard exit), then take the default action (stderr dump
         + os._exit(43))."""
         try:
-            self.metrics.log("stall", **{
+            self.metrics.log("stall", flush=True, **{
                 k: v for k, v in record.items() if k not in ("kind", "time")
             })
             self.metrics.close()
         except Exception:
             pass
+        # Best-effort timeline flush: everything here is host-side, and
+        # the whole point of the file is correlating exactly this kind of
+        # death with what the host was doing.
+        if self.timeline is not None:
+            try:
+                self.timeline.instant("stall", args={
+                    k: v for k, v in record.items()
+                    if isinstance(v, (int, float, str))})
+                self.timeline.write(self.cfg.obs_timeline)
+            except Exception:
+                pass
         _default_on_stall(record)
 
     # ------------------------------------------------------------------ lr
@@ -848,6 +916,7 @@ class Trainer:
                 # opt_state.telemetry). float() blocks until the
                 # dispatched step actually ran — which is also the
                 # watchdog's honest progress proof.
+                observed = False
                 if (cfg.obs_counters and cfg.obs_interval > 0
                         and step % cfg.obs_interval < spd):
                     tel = self.state.opt_state.telemetry
@@ -858,20 +927,31 @@ class Trainer:
                             # per layer; the [N] age buffer stays on
                             # device (its per-layer mean is already in
                             # the layers record).
-                            self.metrics.log("obs", step=step, **{
-                                k: float(v) for k, v in tel.items()
-                                if k not in ("layers", "age")
-                            })
+                            scalars = telemetry_scalars(tel)
+                            self.metrics.log("obs", step=step, **scalars)
+                            max_age = None
                             lay = tel.get("layers")
                             if lay is not None:
                                 cols = {f: np.asarray(v)
                                         for f, v in lay.items()}
+                                ages = cols.get("residual_age")
+                                if ages is not None and ages.size:
+                                    max_age = float(np.max(ages))
                                 for i, lname in enumerate(
                                         self._layer_names):
                                     self.metrics.log(
                                         "layers", step=step, layer=lname,
                                         **{f: float(c[i])
                                            for f, c in cols.items()})
+                            if self.timeline is not None:
+                                self.timeline.counter("obs", scalars)
+                        # The step is already synced by the reads above,
+                        # so feeding the monitor costs nothing extra.
+                        if self.monitor is not None:
+                            self.monitor.observe(
+                                step, loss=float(loss), telemetry=scalars,
+                                max_residual_age=max_age)
+                            observed = True
                         synced = True
                 # With spd > 1 a dispatch may jump over the exact
                 # boundary; log when any step inside it crossed one.
@@ -887,6 +967,13 @@ class Trainer:
                         rec["ppl"] = float(np.exp(min(last_loss, 20.0)))
                     self.metrics.log("train", **rec)
                     self.tracer.flush(step)
+                    if self.timeline is not None:
+                        self.timeline.counter("train", rec)
+                    # Monitor at the log cadence too, so NaN detection
+                    # works with obs counters disabled (loss only — the
+                    # float() above already paid the sync).
+                    if self.monitor is not None and not observed:
+                        self.monitor.observe(step, loss=last_loss)
                     synced = True
                 if wd is not None and synced:
                     wd.heartbeat(step=step)

@@ -33,10 +33,24 @@ class MetricsLogger:
             self._fh = open(os.path.join(out_dir, "metrics.jsonl"), "a",
                             buffering=1)
 
-    def log(self, kind: str, **fields: Any) -> Dict[str, Any]:
+    def log(self, kind: str, *, flush: bool = False,
+            **fields: Any) -> Dict[str, Any]:
+        """``flush=True`` fsyncs the record to disk before returning —
+        for diagnostics that must survive a hard kill in the very next
+        instruction (anomaly ``event`` records, the manifest header);
+        line buffering alone only guarantees the write reaches the OS."""
+        if not isinstance(kind, str) or not kind:
+            raise ValueError(
+                f"metrics kind must be a non-empty str, got {kind!r}")
         rec = {"kind": kind, "time": time.time(), "rank": self.rank, **fields}
         if self._fh is not None:
             self._fh.write(json.dumps(rec) + "\n")
+            if flush:
+                try:
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                except OSError:
+                    pass
         if self.logger is not None:
             human = " ".join(
                 f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"

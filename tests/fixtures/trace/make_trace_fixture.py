@@ -1,0 +1,136 @@
+"""Regenerate cpu_smoke.trace.json.gz — the committed chrome-trace
+fixture behind tests/test_obs_attr.py.
+
+The fixture is a REAL ``jax.profiler`` capture (via
+obs.trace_attr.capture, Python tracer off) of a tiny 2-device CPU-mesh
+program built to exercise every attribution bucket with a handful of
+events:
+
+  compute — a jitted matmul chain (dot ops / fusions)
+  select  — lax.top_k over a vector (lowers to sort on XLA:CPU)
+  comm    — shard_map psum + ppermute (all-reduce / collective-permute)
+
+each dispatched inside the Tracer-style TraceAnnotation scopes the
+trainer emits (train/step, train/step/compress, train/step/comm), so the
+fixture also carries host-lane annotation events. After capture, events
+are FILTERED to metadata + XLA op events + the train/* annotations —
+full traces carry tens of thousands of runtime bookkeeping events that
+would bloat a committed fixture without adding coverage.
+
+Run from the repo root (the fixture is deterministic enough for the
+tests, which assert structure and bucket presence, not exact times):
+
+  python tests/fixtures/trace/make_trace_fixture.py
+"""
+
+from __future__ import annotations
+
+import functools
+import gzip
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "cpu_smoke.trace.json.gz")
+
+
+def build_and_capture(trace_dir: str) -> None:
+    from gtopkssgd_tpu.utils import force_cpu_mesh
+
+    force_cpu_mesh(2)
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import gtopkssgd_tpu  # noqa: F401  (jax.shard_map compat shim)
+    from gtopkssgd_tpu.obs.trace_attr import capture
+    from gtopkssgd_tpu.parallel import make_mesh
+
+    mesh = make_mesh(2)
+
+    @jax.jit
+    def compute(x):
+        for _ in range(4):
+            x = jnp.tanh(x @ x)
+        return x
+
+    @jax.jit
+    def select(v):
+        # lax.sort, not lax.top_k: this jaxlib's CPU top-k lowers to a
+        # reduce-window scheme, while the repo's production selection
+        # (threshold/blockwise tau search) shows up as sort ops in real
+        # trainer traces — which is what the classifier keys on.
+        s = jax.lax.sort(v)
+        return s[-64:].sum()
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+        check_vma=False)
+    def comm(v):
+        s = jax.lax.psum(v, "dp")
+        return jax.lax.ppermute(s, "dp", [(0, 1), (1, 0)])
+
+    x = jnp.ones((128, 128), jnp.float32) * 0.01
+    v = jnp.linspace(-1.0, 1.0, 32768)
+    vs = jnp.ones((2, 4096), jnp.float32)
+
+    # Warm pass: compilation must stay out of the trace.
+    jax.block_until_ready((compute(x), select(v), comm(vs)))
+
+    with capture(trace_dir):
+        for _ in range(3):
+            with jax.profiler.TraceAnnotation("train/step"):
+                jax.block_until_ready(compute(x))
+                with jax.profiler.TraceAnnotation("train/step/compress"):
+                    jax.block_until_ready(select(v))
+                with jax.profiler.TraceAnnotation("train/step/comm"):
+                    jax.block_until_ready(comm(vs))
+
+
+def shrink(trace_dir: str, out_path: str) -> dict:
+    from gtopkssgd_tpu.obs.trace_attr import find_trace_file
+
+    with gzip.open(find_trace_file(trace_dir), "rt") as fh:
+        doc = json.load(fh)
+    kept = []
+    for e in doc.get("traceEvents", []):
+        name = str(e.get("name", ""))
+        if name in ("process_name", "thread_name", "process_sort_index"):
+            kept.append(e)
+        elif "hlo_op" in e.get("args", {}):
+            kept.append(e)
+        elif e.get("ph") == "X" and name.startswith("train/"):
+            kept.append(e)
+    slim = {"traceEvents": kept,
+            "displayTimeUnit": doc.get("displayTimeUnit", "ms")}
+    with gzip.open(out_path, "wt") as fh:
+        json.dump(slim, fh)
+    return slim
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="trace_fixture_") as tmp:
+        build_and_capture(tmp)
+        slim = shrink(tmp, OUT)
+
+    from gtopkssgd_tpu.obs.trace_attr import attribute, format_attr
+
+    rec = attribute(OUT)
+    print(f"wrote {OUT}: {len(slim['traceEvents'])} events, "
+          f"{os.path.getsize(OUT)} bytes")
+    print(format_attr(rec))
+    ok = all(rec[f"frac_{t}"] > 0 for t in ("compute", "select", "comm"))
+    if not ok:
+        print("FIXTURE BAD: some bucket is empty — do not commit")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,8 +18,12 @@ import numpy as np
 import pytest
 
 from gtopkssgd_tpu.obs import (
+    HALT_EXIT_CODE,
     TELEMETRY_FIELDS,
+    AnomalyHalt,
+    AnomalyMonitor,
     StallWatchdog,
+    Thresholds,
     Tracer,
     counters as obs_counters,
 )
@@ -373,6 +377,23 @@ def test_report_compares_two_runs(tmp_path, capsys):
     assert d["delta"] == 200.0 and d["delta_pct"] == pytest.approx(200.0)
 
 
+def test_report_compare_zero_baseline_prints_dash(tmp_path, capsys):
+    # a counter that was 0 in the baseline has no meaningful percent
+    # change — the report must print "—", not "+nan%"/"+inf%"
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    _write_run(a, [{"kind": "obs", "time": 1.0, "rank": 0,
+                    "wire_bytes": 0.0}])
+    _write_run(b, [{"kind": "obs", "time": 1.0, "rank": 0,
+                    "wire_bytes": 300.0}])
+    json_out = str(tmp_path / "diff.json")
+    assert obs_report.main([a, b, "--json", json_out]) == 0
+    out = capsys.readouterr().out
+    assert "—" in out
+    assert "nan%" not in out and "inf%" not in out
+    d = json.load(open(json_out))["diff"]["obs"]["wire_bytes"]
+    assert d["delta"] == 300.0 and d["delta_pct"] is None
+
+
 def test_report_errors_are_exit_code_2(tmp_path, capsys):
     assert obs_report.main([str(tmp_path / "missing")]) == 2
     capsys.readouterr()
@@ -396,6 +417,98 @@ def test_metrics_logger_rank_nonzero_writes_nothing(tmp_path):
     with MetricsLogger(str(tmp_path / "r1"), rank=1) as m:
         m.log("train", step=1, loss=2.0)
     assert not os.path.exists(str(tmp_path / "r1" / "metrics.jsonl"))
+
+
+def test_metrics_logger_flush_is_durable_and_kind_validated(tmp_path):
+    m = MetricsLogger(str(tmp_path))
+    try:
+        m.log("event", flush=True, rule="nan_loss", severity="error", step=3)
+        # flush=True fsyncs: the record is on disk while the logger is
+        # still open (what keeps a diagnosis through a hard kill)
+        recs = [json.loads(l) for l in
+                open(os.path.join(tmp_path, "metrics.jsonl"))]
+        assert recs[-1]["rule"] == "nan_loss"
+        with pytest.raises(ValueError):
+            m.log("", step=1)
+        with pytest.raises(ValueError):
+            m.log(None, step=1)
+    finally:
+        m.close()
+
+
+# ------------------------------------------------------- anomaly monitor
+
+def test_monitor_nan_loss_fires_error_event():
+    mon = AnomalyMonitor(rho=0.01)
+    (ev,) = mon.observe(3, loss=float("nan"))
+    assert ev["rule"] == "nan_loss" and ev["severity"] == "error"
+    assert ev["step"] == 3 and ev["value"] is None
+    (ev,) = mon.observe(4, loss=float("inf"))
+    assert ev["rule"] == "nan_loss"
+    assert mon.summary() == {"nan_loss": 2}
+
+
+def test_monitor_loss_spike_needs_warmup_and_variance():
+    mon = AnomalyMonitor(thresholds=Thresholds(loss_warmup=3))
+    for step, loss in enumerate([1.0, 1.02, 0.98, 1.0, 1.01]):
+        assert mon.observe(step, loss=loss) == []
+    (ev,) = mon.observe(9, loss=50.0)          # many sigma above the EWMA
+    assert ev["rule"] == "loss_spike" and ev["severity"] == "warn"
+    assert ev["value"] > ev["threshold"] == 6.0
+    # a steady loss after the spike decays back to silence
+    assert mon.observe(10, loss=1.0) == []
+
+
+def test_monitor_density_collapse_requires_rho():
+    mon = AnomalyMonitor(rho=0.01)
+    (ev,) = mon.observe(1, loss=1.0,
+                        telemetry={"achieved_density": 0.0001})
+    assert ev["rule"] == "density_collapse"
+    assert ev["threshold"] == pytest.approx(0.001)
+    # healthy density: silent
+    assert mon.observe(2, loss=1.0,
+                       telemetry={"achieved_density": 0.01}) == []
+    # dense runs (rho None) never evaluate the rule
+    dense = AnomalyMonitor(rho=None)
+    assert dense.observe(1, loss=1.0,
+                         telemetry={"achieved_density": 0.0}) == []
+
+
+def test_monitor_residual_blowup_and_age_runaway():
+    mon = AnomalyMonitor(rho=0.01, thresholds=Thresholds(loss_warmup=3))
+    for step in range(4):
+        assert mon.observe(step, telemetry={"residual_norm": 1.0}) == []
+    (ev,) = mon.observe(9, telemetry={"residual_norm": 100.0})
+    assert ev["rule"] == "residual_blowup"
+    # auto age threshold is 100/rho = 1e4 steps
+    assert Thresholds().age_max(0.01) == pytest.approx(1e4)
+    assert Thresholds(residual_age_max=5.0).age_max(0.01) == 5.0
+    (ev,) = AnomalyMonitor(rho=0.01).observe(1, max_residual_age=2e4)
+    assert ev["rule"] == "residual_age_runaway"
+    assert AnomalyMonitor(rho=None).observe(1, max_residual_age=1e9) == []
+
+
+def test_monitor_halt_severity_ordering(tmp_path):
+    with pytest.raises(ValueError):
+        AnomalyMonitor(halt_on="fatal")
+    # error-level halt ignores warns but trips on nan_loss — and the
+    # event record is durably written BEFORE the raise
+    with MetricsLogger(str(tmp_path)) as metrics:
+        mon = AnomalyMonitor(metrics=metrics, rho=0.01, halt_on="error")
+        assert [e["rule"] for e in mon.observe(
+            1, loss=1.0, telemetry={"achieved_density": 0.0})] \
+            == ["density_collapse"]
+        with pytest.raises(AnomalyHalt) as exc:
+            mon.observe(2, loss=float("nan"))
+        assert exc.value.event["rule"] == "nan_loss"
+        recs = [json.loads(l) for l in
+                open(os.path.join(tmp_path, "metrics.jsonl"))]
+        assert [r["rule"] for r in recs if r["kind"] == "event"] \
+            == ["density_collapse", "nan_loss"]
+    # warn-level halt trips on the first warn
+    mon = AnomalyMonitor(rho=0.01, halt_on="warn")
+    with pytest.raises(AnomalyHalt):
+        mon.observe(1, loss=1.0, telemetry={"achieved_density": 0.0})
 
 
 # ------------------------------------------------------- trainer smoke
@@ -646,3 +759,99 @@ def test_gate_smoke_matches_committed_baseline(tmp_path):
 
     out = run_smoke(str(tmp_path / "run"))
     assert obs_report.run_gate(out, BASELINE) == 0
+
+
+# --------------------------------------------- anomaly events in training
+
+def _event_cfg(out, **overrides):
+    """2-device CPU-mesh trainer at the monitor's tightest cadence."""
+    from gtopkssgd_tpu.trainer import TrainConfig
+
+    kw = dict(dnn="resnet20", batch_size=4, nworkers=2,
+              compression="gtopk_layerwise", density=0.01, seed=42,
+              max_epochs=1, log_interval=1, obs_interval=1, eval_batches=1,
+              out_dir=out)
+    kw.update(overrides)
+    return TrainConfig(**kw)
+
+
+def _patch_loss(monkeypatch, scale):
+    """Wrap Trainer._loss_fn so the scalar loss becomes loss * scale —
+    NaN injects a divergence, 0.0 zeroes every gradient (and therefore
+    the achieved density) without touching the trainer's plumbing."""
+    from gtopkssgd_tpu.trainer import Trainer
+
+    orig = Trainer._loss_fn
+
+    def poisoned(self, params, batch_stats, carry, batch, rng, train):
+        loss, rest = orig(self, params, batch_stats, carry, batch, rng,
+                          train)
+        return loss * scale, rest
+
+    monkeypatch.setattr(Trainer, "_loss_fn", poisoned)
+
+
+def test_trainer_nan_loss_event_and_halt_within_one_step(
+        tmp_path, monkeypatch):
+    """The acceptance property: an injected NaN produces a durably
+    written event record AND (with --obs-halt-on error semantics) stops
+    the run, both within a single step."""
+    from gtopkssgd_tpu.trainer import Trainer
+
+    _patch_loss(monkeypatch, jnp.nan)
+    out = str(tmp_path / "run")
+    with Trainer(_event_cfg(out, obs_halt_on="error")) as t:
+        with pytest.raises(AnomalyHalt) as exc:
+            t.train(2)
+    assert exc.value.event["rule"] == "nan_loss"
+    recs = [json.loads(l) for l in open(os.path.join(out, "metrics.jsonl"))]
+    evs = [r for r in recs if r["kind"] == "event"]
+    assert evs, "no event record written"
+    assert evs[0]["rule"] == "nan_loss"
+    assert evs[0]["severity"] == "error"
+    assert evs[0]["step"] == 1               # caught within one step
+    # the report CLI reads the stream back
+    assert obs_report.main(["events", out]) == 0
+
+
+def test_trainer_density_collapse_event_and_timeline(
+        tmp_path, monkeypatch):
+    _patch_loss(monkeypatch, 0.0)            # zero grads -> nothing selected
+    from gtopkssgd_tpu.trainer import Trainer
+
+    out = str(tmp_path / "run")
+    with Trainer(_event_cfg(out, obs_timeline=out)) as t:
+        t.train(2)                           # no halt configured: runs on
+    recs = [json.loads(l) for l in open(os.path.join(out, "metrics.jsonl"))]
+    evs = [r for r in recs if r["kind"] == "event"]
+    rules = {r["rule"] for r in evs}
+    assert "density_collapse" in rules
+    assert "nan_loss" not in rules           # loss 0.0 is finite
+    first = min(r["step"] for r in evs if r["rule"] == "density_collapse")
+    assert first == 1                        # caught within one step
+    # the live timeline was written on exit and carries the marker
+    from gtopkssgd_tpu.obs import validate_timeline
+
+    doc = json.load(open(os.path.join(out, "timeline.json")))
+    assert validate_timeline(doc) == []
+    names = [e.get("name") for e in doc["traceEvents"]]
+    assert "event:density_collapse" in names
+    assert "dispatch" in names               # Tracer spans flowed through
+
+
+def test_dist_trainer_halt_exit_code(tmp_path, monkeypatch):
+    from gtopkssgd_tpu import dist_trainer
+
+    _patch_loss(monkeypatch, jnp.nan)
+    assert HALT_EXIT_CODE == 44              # the watchdog owns 43
+    rc = dist_trainer.main([
+        "--dnn", "resnet20", "--batch-size", "4", "--nworkers", "2",
+        "--compression", "gtopk_layerwise", "--density", "0.01",
+        "--num-iters", "2", "--eval-batches", "1", "--log-interval", "1",
+        "--obs-halt-on", "error", "--out-dir", str(tmp_path / "run"),
+    ])
+    assert rc == HALT_EXIT_CODE
+    recs = [json.loads(l) for l in
+            open(str(tmp_path / "run" / "metrics.jsonl"))]
+    assert any(r["kind"] == "event" and r["rule"] == "nan_loss"
+               for r in recs)
