@@ -69,7 +69,12 @@ def run_smoke(out_dir: str) -> str:
     (obs.trace_attr.capture — Python tracer off, so op events survive)
     and the paper's T_compute/T_select/T_comm split of that trace is
     logged as an "attr" record, putting the decomposition itself under
-    the drift gate's frac checks."""
+    the drift gate's frac checks. Finally the run's own records are
+    fleet-merged (obs/fleet.py) and logged back as "fleet" records: on
+    this single-process run the merge is a 1-rank fleet, so n_ranks is
+    exactly 1 and every skew_max exactly 0 — structural invariants the
+    baseline pins, putting the merge path itself under the drift gate."""
+    from gtopkssgd_tpu.obs import fleet
     from gtopkssgd_tpu.obs.trace_attr import attribute, capture
     from gtopkssgd_tpu.trainer import Trainer
 
@@ -86,6 +91,13 @@ def run_smoke(out_dir: str) -> str:
         else:
             t.metrics.log("attr", flush=True, **{
                 k: v for k, v in rec.items() if v is not None})
+        # The metrics file is line-buffered, so everything logged above
+        # is already readable mid-run; merge obs records only (train
+        # records at log_interval=2 over 6 steps give 3 more rows each
+        # but no extra coverage).
+        merged = fleet.merge([out_dir], kinds=("obs",))
+        for row in merged["rows"]:
+            t.metrics.log("fleet", **fleet.row_record(row))
     return out_dir
 
 

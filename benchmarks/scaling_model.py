@@ -88,39 +88,57 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
     log2(n_slices) rounds cross DCN; allgather = gather s*k within the
     slice, then pull the other slices' (p-s)*k over DCN.
     """
+    comm_ms = predict(mode, p, n=n, k=k, ici_gbps=ici_gbps,
+                      dcn_gbps=dcn_gbps, ici_size=ici_size,
+                      dcn_alpha_ms=dcn_alpha_ms)
+    extra = 0.0 if mode == "dense" else overhead_ms
+    step_ms = compute_ms + extra + comm_ms
+    return {
+        "mode": mode,
+        "p": p,
+        "comm_ms": round(comm_ms, 3),
+        "step_ms": round(step_ms, 3),
+        "images_per_sec_per_chip": round(batch / step_ms * 1e3, 1),
+    }
+
+
+def predict(mode: str, p: int, *, n: int, k: int, ici_gbps: float,
+            dcn_gbps: float, ici_size: int,
+            dcn_alpha_ms: float = 0.0) -> float:
+    """Predicted comm_ms alone — the comm-model ledger's entry point
+    (obs/ledger.py joins this against measured per-step T_comm). Same
+    model as project(), with the compute/overhead/throughput bookkeeping
+    stripped: the ledger compares communication, the only term the
+    alpha-beta model actually predicts. Unrounded (ratio math should not
+    inherit display rounding); map gtopk_layerwise to gtopk on the wire
+    exactly as project() documents."""
+    # The layerwise mode's wire cost IS gtopk's: the layerwise K differs
+    # from ceil(rho*N) only by the +1-per-tiny-leaf ceil rounding (<1%
+    # for ResNet-50 at rho=1e-3).
+    wire_mode = "gtopk" if mode == "gtopk_layerwise" else mode
     ici_Bps = ici_gbps * 1e9 / 8
     dcn_Bps = dcn_gbps * 1e9 / 8
     s = min(ici_size, p)
     # ceil, not floor: p=24 with 16-chip slices IS a 2-slice job that
     # crosses DCN (a floor would model it as one all-ICI slice and
-    # charge zero DCN cost). Ragged counts are first-class since round 5:
-    # non-pow2 axes run the masked hypercube in-tree
-    # (parallel.collectives._merge_tree), log2(m) + 2 rounds with
-    # m = 2^floor(log2 x) — modeled by _tree_rounds (the
-    # implementation's own round count).
+    # charge zero DCN cost). Ragged counts are first-class: non-pow2 axes
+    # run the masked hypercube in-tree (parallel.collectives._merge_tree),
+    # log2(m) + 2 rounds with m = 2^floor(log2 x) — modeled by
+    # _tree_rounds (the implementation's own round count).
     n_slices = max(1, math.ceil(p / s))
     dcn_rounds = _tree_rounds(n_slices)
-
-    if mode == "dense":
-        ici_ms = _ring_allreduce_bytes(4 * n, s) / ici_Bps * 1e3
-        dcn_ms = (_ring_allreduce_bytes(4 * n, n_slices) / dcn_Bps * 1e3
-                  + 2 * (n_slices - 1) * dcn_alpha_ms)
-        comm_ms = ici_ms + dcn_ms
-        extra = 0.0
-    elif mode == "gtopk":
-        # This row also covers gtopk_layerwise on the wire: the layerwise
-        # K differs from ceil(rho*N) only by the +1-per-tiny-leaf ceil
-        # rounding (<1% for ResNet-50 at rho=1e-3), and its p=1 overhead
-        # is expected LOWER than overhead_ms (no flat serial tail — the
-        # [N] gradient never materializes; A/B on chip via
-        # bench.py --compression gtopk_layerwise).
+    if wire_mode == "dense":
+        return (_ring_allreduce_bytes(4 * n, s) / ici_Bps * 1e3
+                + _ring_allreduce_bytes(4 * n, n_slices) / dcn_Bps * 1e3
+                + 2 * (n_slices - 1) * dcn_alpha_ms)
+    if wire_mode == "gtopk":
         # Split the flat tree's tree_rounds(p) by the link each round
         # actually crosses: hypercube rounds whose XOR bit stays inside a
         # slice pair ICI neighbors; larger bits — and the ragged
         # fold/unfold, which spans slices whenever p > s — cross DCN.
-        # (p=24, s=16: 6 rounds total = 4 ICI + fold/unfold on DCN; the
-        # earlier tree_rounds(s)+tree_rounds(n_slices) split dropped one
-        # DCN round at exactly those ragged shapes.)
+        # (p=24, s=16: 6 rounds total = 4 ICI + fold/unfold on DCN; a
+        # tree_rounds(s)+tree_rounds(n_slices) split drops one DCN round
+        # at exactly those ragged shapes.)
         total_rounds = _tree_rounds(p)
         if n_slices == 1:
             ici_rounds, flat_dcn_rounds = total_rounds, 0
@@ -133,31 +151,17 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
             # floor(log2) is the intended count for ragged s too.
             ici_rounds = min(m, s).bit_length() - 1
             flat_dcn_rounds = total_rounds - ici_rounds
-        comm_ms = (ici_rounds * (8 * k) / ici_Bps * 1e3
-                   + flat_dcn_rounds * ((8 * k) / dcn_Bps * 1e3
-                                        + dcn_alpha_ms))
-        extra = overhead_ms
-    elif mode == "allgather":
-        comm_ms = ((8 * k * s) / ici_Bps * 1e3
-                   + (8 * k * (p - s)) / dcn_Bps * 1e3
-                   + (n_slices - 1) * dcn_alpha_ms)
-        extra = overhead_ms
-    elif mode == "gtopk_hier":
-        ici_ms = _ring_allreduce_bytes(4 * n, s) / ici_Bps * 1e3
-        dcn_ms = dcn_rounds * ((8 * k) / dcn_Bps * 1e3 + dcn_alpha_ms)
-        comm_ms = ici_ms + dcn_ms
-        extra = overhead_ms
-    else:
-        raise ValueError(mode)
-
-    step_ms = compute_ms + extra + comm_ms
-    return {
-        "mode": mode,
-        "p": p,
-        "comm_ms": round(comm_ms, 3),
-        "step_ms": round(step_ms, 3),
-        "images_per_sec_per_chip": round(batch / step_ms * 1e3, 1),
-    }
+        return (ici_rounds * (8 * k) / ici_Bps * 1e3
+                + flat_dcn_rounds * ((8 * k) / dcn_Bps * 1e3
+                                     + dcn_alpha_ms))
+    if wire_mode == "allgather":
+        return ((8 * k * s) / ici_Bps * 1e3
+                + (8 * k * (p - s)) / dcn_Bps * 1e3
+                + (n_slices - 1) * dcn_alpha_ms)
+    if wire_mode == "gtopk_hier":
+        return (_ring_allreduce_bytes(4 * n, s) / ici_Bps * 1e3
+                + dcn_rounds * ((8 * k) / dcn_Bps * 1e3 + dcn_alpha_ms))
+    raise ValueError(mode)
 
 
 def main():

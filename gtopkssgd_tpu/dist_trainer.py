@@ -58,9 +58,16 @@ reference's only telemetry was text logs):
                                          counter tracks, event/stall
                                          markers) to PATH on exit — open
                                          in chrome://tracing or Perfetto
+    --obs-export-port PORT               serve the latest metric values as
+                                         OpenMetrics text on localhost
+                                         (curl localhost:PORT/metrics);
+                                         0 = off (default), -1 = ephemeral
 
 Summarize or diff the resulting metrics.jsonl with
 ``python -m gtopkssgd_tpu.obs.report <out-dir> [<other-out-dir>]``.
+Multi-host runs shard metrics per rank (metrics.rank{r}.jsonl); merge
+them with ``python -m gtopkssgd_tpu.obs.report fleet <out-dir>`` and
+tail a live run with ``... report watch <out-dir>``.
 """
 
 from __future__ import annotations
@@ -200,6 +207,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "in chrome://tracing or ui.perfetto.dev. Rebuild "
                         "one later from metrics.jsonl with 'python -m "
                         "gtopkssgd_tpu.obs.report timeline <out-dir>'")
+    p.add_argument("--obs-export-port", type=int, default=0,
+                   help="serve the latest metric values as OpenMetrics "
+                        "text on this localhost HTTP port "
+                        "(obs.exporter; curl localhost:PORT/metrics); "
+                        "0 disables (default), -1 binds an ephemeral "
+                        "port (logged at startup)")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint from out-dir")
     p.add_argument("--multihost", action="store_true",
@@ -249,6 +262,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         obs_events=args.obs_events,
         obs_halt_on=args.obs_halt_on,
         obs_timeline=args.obs_timeline,
+        obs_export_port=args.obs_export_port,
         prefetch=args.prefetch,
         decode_workers=args.decode_workers,
     )
@@ -264,6 +278,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # program; ICI inside a slice, DCN across slices — both are just the
         # 'dp' axis to the program (reference: MPI.COMM_WORLD over ethernet).
         jax.distributed.initialize()
+        # Announce this process's fleet identity up front — the same
+        # process_index/count/coordinator triple lands in each shard's
+        # run manifest (obs/manifest.py), which is how the fleet merger
+        # validates that shards being merged belong to one run.
+        from gtopkssgd_tpu.obs.manifest import coordinator_address
+
+        print(f"[dist] process {jax.process_index()}/"
+              f"{jax.process_count()} coordinator="
+              f"{coordinator_address()}", flush=True)
     from gtopkssgd_tpu.obs.events import HALT_EXIT_CODE, AnomalyHalt
 
     with Trainer(config_from_args(args)) as trainer:

@@ -44,8 +44,25 @@ residual dynamics). This package turns the repo's scattered primitives
       ``attr`` / ``events`` / ``timeline`` subcommands over the three
       modules above.
   manifest.py — run-manifest header (config hash, resolved headline
-      flags, mesh shape, jax/backend versions, git sha) written as the
-      first record of every metrics.jsonl so runs are self-describing.
+      flags, mesh shape, jax/backend versions, git sha, process index /
+      coordinator for multi-host) written as the first record of every
+      metrics file so runs are self-describing.
+  fleet.py    — cross-host layer: multi-process runs shard metrics per
+      rank (metrics.rank{r}.jsonl); the merger aligns records by
+      (kind, step) across ranks into per-step min/median/max/std rows
+      with a per-rank skew vector, validates shards via each manifest's
+      config_hash, and attributes the per-step slowest rank (persistent
+      vs transient via an EWMA of rank lag — the straggler_persistent
+      anomaly rule, so --obs-halt-on covers it).
+  ledger.py   — comm-model ledger: joins measured per-step T_comm (attr
+      records) and wire bytes (obs counters) against the alpha-beta
+      scaling model (benchmarks/scaling_model.predict, fed by
+      dcn_probe's fitted alpha/beta when present) into
+      predicted-vs-measured ratio rows.
+  exporter.py — live OpenMetrics endpoint (``--obs-export-port``):
+      stdlib http.server thread serving the latest value of every
+      metric field at localhost:PORT/metrics; wired in as the
+      MetricsLogger sink.
 
 Per-layer counters (counters.LAYER_FIELDS, flag-gated): achieved
 density, tau, pre/post-compression norms, error-feedback residual norm
@@ -76,7 +93,13 @@ from gtopkssgd_tpu.obs.events import (
     AnomalyMonitor,
     Thresholds,
 )
-from gtopkssgd_tpu.obs.manifest import config_hash, git_sha, run_manifest
+from gtopkssgd_tpu.obs.exporter import MetricsExporter
+from gtopkssgd_tpu.obs.manifest import (
+    config_hash,
+    coordinator_address,
+    git_sha,
+    run_manifest,
+)
 from gtopkssgd_tpu.obs.timeline import (
     TimelineRecorder,
     timeline_from_records,
@@ -91,11 +114,13 @@ __all__ = [
     "TELEMETRY_FIELDS",
     "AnomalyHalt",
     "AnomalyMonitor",
+    "MetricsExporter",
     "Thresholds",
     "TimelineRecorder",
     "Tracer",
     "StallWatchdog",
     "config_hash",
+    "coordinator_address",
     "git_sha",
     "keep_tau",
     "layer_names",

@@ -22,6 +22,15 @@ synced (no extra device reads):
                                   auto threshold 100/rho — uniform
                                   rotation re-ships a coordinate every
                                   ~1/rho steps)
+  straggler_persistent  warn      a rank's EWMA sync-point lag exceeds
+                                  straggler_lag_s (auto: straggler_lag_x
+                                  x the observed step duration) after
+                                  straggler_warmup merged steps — the
+                                  same host is late EVERY step, not a
+                                  one-off GC pause. Fed by the fleet
+                                  merger (obs/fleet.py) through
+                                  ``observe_ranks``, so --obs-halt-on
+                                  covers it like any other rule
 
 Each firing emits one severity-tagged ``event`` record through
 MetricsLogger with ``flush=True`` (fsync'd — a run killed one line later
@@ -66,12 +75,28 @@ class Thresholds:
     density_collapse_frac: float = 0.1   # achieved < frac * rho
     residual_blowup_x: float = 10.0  # residual_norm vs its EWMA
     residual_age_max: float = 0.0    # steps; 0 = auto (100 / rho)
+    straggler_lag_s: float = 0.0     # seconds; 0 = auto (lag_x * step dur)
+    straggler_lag_x: float = 2.0     # auto threshold: x * step duration
+    straggler_ewma_alpha: float = 0.3    # EWMA decay for per-rank lag
+    straggler_warmup: int = 2        # merged steps before the rule arms
 
     def age_max(self, rho: Optional[float]) -> float:
         if self.residual_age_max > 0:
             return self.residual_age_max
         if rho and rho > 0:
             return 100.0 / rho
+        return math.inf
+
+    def straggler_threshold(self, step_dur: Optional[float]) -> float:
+        """Seconds of EWMA lag that makes a rank a persistent straggler.
+        Explicit straggler_lag_s wins; otherwise auto-scale to the run's
+        own cadence (a 50 ms-step fleet and a 5 s-step fleet get sane
+        thresholds from the same default). No cadence estimate, no auto
+        rule — better silent than noisy."""
+        if self.straggler_lag_s > 0:
+            return self.straggler_lag_s
+        if step_dur is not None and step_dur > 0:
+            return self.straggler_lag_x * step_dur
         return math.inf
 
 
@@ -108,6 +133,10 @@ class AnomalyMonitor:
         self._loss_n = 0
         self._res_mean: Optional[float] = None
         self._res_n = 0
+        # Per-rank EWMA of sync-point lag (seconds), fed by observe_ranks
+        # from the fleet merger; public — fleet straggler rows report it.
+        self.rank_lag_ewma: Dict[int, float] = {}
+        self._rank_lag_n: Dict[int, int] = {}
 
     # ---------------------------------------------------------- the rules
     def _check(self, step: int, loss: Optional[float],
@@ -175,15 +204,44 @@ class AnomalyMonitor:
                  f"steps exceeds {age_max:.0f} (starved coordinates)")
         return out
 
+    # ------------------------------------------------- straggler (fleet)
+    def _check_ranks(self, step: int, lags: Dict[int, float],
+                     step_dur: Optional[float]) -> List[Dict[str, Any]]:
+        th = self.th
+        threshold = th.straggler_threshold(step_dur)
+        out: List[Dict[str, Any]] = []
+        for rank in sorted(lags):
+            lag = float(lags[rank])
+            if not _finite(lag):
+                continue
+            # Arm-before-update, like residual_blowup: a rank must have
+            # been late for straggler_warmup prior merged steps before
+            # its current EWMA can fire — one slow step never does.
+            ewma = self.rank_lag_ewma.get(rank)
+            n = self._rank_lag_n.get(rank, 0)
+            if (n >= th.straggler_warmup and ewma is not None
+                    and ewma > threshold):
+                out.append({
+                    "rule": "straggler_persistent", "severity": "warn",
+                    "step": step, "value": round(ewma, 6),
+                    "threshold": (round(threshold, 6)
+                                  if math.isfinite(threshold) else None),
+                    "rank_behind": rank,
+                    "message": (f"rank {rank} EWMA sync lag {ewma:.3g}s "
+                                f"exceeds {threshold:.3g}s over {n} "
+                                "merged steps (persistent straggler)"),
+                })
+            a = th.straggler_ewma_alpha
+            self.rank_lag_ewma[rank] = (
+                lag if ewma is None else ewma + a * (lag - ewma))
+            self._rank_lag_n[rank] = n + 1
+        return out
+
     # ------------------------------------------------------------- public
-    def observe(self, step: int, loss: Optional[float] = None,
-                telemetry: Optional[Dict[str, float]] = None,
-                max_residual_age: Optional[float] = None
-                ) -> List[Dict[str, Any]]:
-        """Evaluate every rule against one step's synced scalars; emit
-        and return the fired events. Raises AnomalyHalt AFTER all records
-        are flushed when any event reaches the halt severity."""
-        fired = self._check(step, loss, telemetry, max_residual_age)
+    def _emit(self, fired: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        """Record, persist (fsync'd), mark on the timeline, and — after
+        everything is durably written — raise if any event reaches the
+        halt severity. Shared by observe and observe_ranks."""
         halting = None
         for ev in fired:
             self.events.append(ev)
@@ -198,6 +256,25 @@ class AnomalyMonitor:
         if halting is not None:
             raise AnomalyHalt(halting)
         return fired
+
+    def observe(self, step: int, loss: Optional[float] = None,
+                telemetry: Optional[Dict[str, float]] = None,
+                max_residual_age: Optional[float] = None
+                ) -> List[Dict[str, Any]]:
+        """Evaluate every rule against one step's synced scalars; emit
+        and return the fired events. Raises AnomalyHalt AFTER all records
+        are flushed when any event reaches the halt severity."""
+        return self._emit(self._check(step, loss, telemetry,
+                                      max_residual_age))
+
+    def observe_ranks(self, step: int, lags: Dict[int, float],
+                      step_dur: Optional[float] = None
+                      ) -> List[Dict[str, Any]]:
+        """Evaluate the straggler rule against one merged step's per-rank
+        sync-point lags (seconds behind the first rank, from the fleet
+        merger). Same emit/halt contract as observe — a persistent
+        straggler trips --obs-halt-on warn exactly like a loss spike."""
+        return self._emit(self._check_ranks(step, dict(lags), step_dur))
 
     def summary(self) -> Dict[str, int]:
         """{rule: count} over the monitor's lifetime (test/report aid)."""

@@ -1,0 +1,328 @@
+"""Fleet layer: merge per-rank metric shards, find the slow host.
+
+gTop-k S-SGD's value proposition is behavior on low-bandwidth MULTI-worker
+networks (arXiv:1901.04359), and synchronous SPMD step time is the max
+over ranks — yet until this module every obs tool was single-rank-deep: a
+``--multihost`` run produced one shard per process and nothing could merge
+them, compare ranks, or name the straggler that dominates every step.
+Ok-Topk (arXiv:2201.07598) and the top-k analysis paper (arXiv:1911.08772)
+both identify cross-worker imbalance in selection/communication cost as
+the first-order effect at scale; this is the layer that measures it.
+
+Pieces (all host-side, stdlib-only — report-CLI friendly):
+
+  find_shards / load_shards  deterministic shard discovery
+      (``metrics.rank{r}.jsonl``, ``metrics.jsonl`` = rank 0) and parsing.
+  validate_shards            join-key validation off the manifest headers
+      every shard carries: a merge is refused when ``config_hash`` differs
+      across shards (two different runs dumped into one dir is archaeology
+      corruption, not a fleet).
+  fleet_rows                 align records by (kind, step) across ranks
+      into per-(step, field) rows with min/median/max/mean/std, the
+      per-rank skew vector (value - median) and ``skew_max``; plus a
+      ``lag_s`` row per step from the records' wall-clock arrival times —
+      which host reached the sync point late, and by how much.
+  straggler_rows             per-step slowest-rank attribution on top of
+      the lag rows (which rank, how far behind the median) and
+      persistent-vs-transient classification via a per-rank EWMA of lag,
+      fed through AnomalyMonitor.observe_ranks so the
+      ``straggler_persistent`` rule emits ordinary ``event`` records and
+      ``--obs-halt-on`` covers it.
+  merge                      the one-call entry (report ``fleet``
+      subcommand, gate smoke): shards in, rows + straggler attribution +
+      fired events + the validated manifest out.
+
+Ragged shards are first-class: a rank missing a step (crashed, still
+catching up, thinned logging) drops out of that step's stats — ``n_ranks``
+records how many actually contributed — and never aborts the merge.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from gtopkssgd_tpu.obs.events import AnomalyMonitor
+from gtopkssgd_tpu.obs.report import extract_manifest, load_records
+from gtopkssgd_tpu.utils.metrics import shard_filename, shard_rank
+
+# Record kinds that carry a per-step stream worth merging across ranks.
+# "layers" is excluded by default (per-layer x per-rank explodes row
+# count); pass kinds=("layers",) explicitly to get it.
+DEFAULT_KINDS = ("obs", "train", "spans")
+
+# Fields that are bookkeeping, not per-rank measurements.
+_SKIP_FIELDS = {"kind", "time", "rank", "step"}
+
+
+def find_shards(target: str) -> Dict[int, str]:
+    """{rank: path} for one target.
+
+    A directory yields its ``metrics.rank{r}.jsonl`` shards, falling back
+    to ``metrics.jsonl`` as rank 0 (single-process runs merge as a
+    1-rank fleet — skew 0 by construction). A file path yields the rank
+    encoded in its name, or rank 0 for non-shard names.
+    """
+    if os.path.isdir(target):
+        shards = {}
+        for name in sorted(os.listdir(target)):
+            r = shard_rank(name)
+            if r is not None:
+                shards[r] = os.path.join(target, name)
+        if not shards:
+            single = os.path.join(target, "metrics.jsonl")
+            if os.path.exists(single):
+                shards[0] = single
+        if not shards:
+            raise FileNotFoundError(
+                f"{target}: no metrics.rank*.jsonl shards and no "
+                "metrics.jsonl")
+        return shards
+    r = shard_rank(target)
+    return {r if r is not None else 0: target}
+
+
+def resolve_targets(targets: Sequence[str]) -> Dict[int, str]:
+    """Union of find_shards over many targets (dirs and/or files). Two
+    targets claiming the same rank is a usage error — the caller is about
+    to merge two different runs' shards under one join key."""
+    shards: Dict[int, str] = {}
+    for t in targets:
+        for r, path in find_shards(t).items():
+            if r in shards and os.path.abspath(shards[r]) != \
+                    os.path.abspath(path):
+                raise ValueError(
+                    f"rank {r} appears twice ({shards[r]} and {path}); "
+                    "merge one run's shards at a time")
+            shards[r] = path
+    return shards
+
+
+def load_shards(shards: Mapping[int, str]
+                ) -> Tuple[Dict[int, List[dict]], int]:
+    """{rank: records} plus the total malformed-line count (torn final
+    lines in killed runs are expected, never fatal)."""
+    out, bad = {}, 0
+    for r in sorted(shards):
+        records, b = load_records(shards[r])
+        out[r] = records
+        bad += b
+    return out, bad
+
+
+def validate_shards(records_by_rank: Mapping[int, List[dict]],
+                    allow_mismatch: bool = False) -> Optional[dict]:
+    """Check every shard's manifest header agrees on ``config_hash`` (the
+    full-config join key) and return the reference manifest. Shards
+    without a manifest are tolerated (pre-manifest runs, hand-built
+    fixtures); a HASH MISMATCH is refused — those shards are provably
+    from different runs and any per-step comparison would be noise."""
+    manifests = {r: extract_manifest(recs)
+                 for r, recs in records_by_rank.items()}
+    hashes = {r: m.get("config_hash") for r, m in manifests.items()
+              if m is not None and m.get("config_hash")}
+    if len(set(hashes.values())) > 1 and not allow_mismatch:
+        detail = ", ".join(f"rank {r}: {h}" for r, h in sorted(hashes.items()))
+        raise ValueError(
+            f"config_hash mismatch across shards ({detail}); these are "
+            "different runs — re-merge with matching shards (or "
+            "allow_mismatch=True to force)")
+    for m in manifests.values():
+        if m is not None:
+            return m
+    return None
+
+
+def _median(vals: Sequence[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _std(vals: Sequence[float], mean: float) -> float:
+    if len(vals) < 2:
+        return 0.0
+    return math.sqrt(sum((v - mean) ** 2 for v in vals) / len(vals))
+
+
+def _stats_row(src: str, step: float, field: str,
+               per_rank: Dict[int, float], center: str = "median") -> dict:
+    vals = list(per_rank.values())
+    mean = sum(vals) / len(vals)
+    med = _median(vals)
+    ref = med if center == "median" else min(vals)
+    skew = {f"r{r}": per_rank[r] - ref for r in sorted(per_rank)}
+    return {
+        "src": src, "step": step, "field": field,
+        "n_ranks": len(per_rank),
+        "min": min(vals), "median": med, "max": max(vals),
+        "mean": mean, "std": _std(vals, mean),
+        "skew": skew,
+        "skew_max": max(abs(d) for d in skew.values()),
+    }
+
+
+def _index_by_step(records_by_rank: Mapping[int, List[dict]],
+                   kinds: Sequence[str]
+                   ) -> Dict[Tuple[str, float], Dict[int, dict]]:
+    """{(kind, step): {rank: record}} — last record wins when a rank
+    logged the same (kind, step) twice (restarted window)."""
+    idx: Dict[Tuple[str, float], Dict[int, dict]] = {}
+    for rank, records in records_by_rank.items():
+        for rec in records:
+            kind = rec.get("kind")
+            step = rec.get("step")
+            if kind not in kinds or not isinstance(step, (int, float)) \
+                    or isinstance(step, bool):
+                continue
+            idx.setdefault((str(kind), float(step)), {})[rank] = rec
+    return idx
+
+
+def fleet_rows(records_by_rank: Mapping[int, List[dict]],
+               kinds: Sequence[str] = DEFAULT_KINDS) -> List[dict]:
+    """The merged view: one row per (src kind, step, field) with cross-
+    rank min/median/max/mean/std and the per-rank skew vector, plus a
+    ``lag_s`` row per (src kind, step) from record arrival times (value
+    per rank = seconds behind the FIRST rank to log that step — the
+    direct fingerprint of the host everyone else waited for)."""
+    rows: List[dict] = []
+    for (kind, step), per_rank in sorted(_index_by_step(
+            records_by_rank, kinds).items()):
+        fields = sorted({
+            key for rec in per_rank.values() for key, val in rec.items()
+            if key not in _SKIP_FIELDS and not isinstance(val, bool)
+            and isinstance(val, (int, float))
+        })
+        for field in fields:
+            vals = {r: float(rec[field]) for r, rec in per_rank.items()
+                    if isinstance(rec.get(field), (int, float))
+                    and not isinstance(rec.get(field), bool)}
+            if vals:
+                rows.append(_stats_row(kind, step, field, vals))
+        times = {r: float(rec["time"]) for r, rec in per_rank.items()
+                 if isinstance(rec.get("time"), (int, float))}
+        if times:
+            t0 = min(times.values())
+            lags = {r: t - t0 for r, t in times.items()}
+            rows.append(_stats_row(kind, step, "lag_s", lags, center="min"))
+    return rows
+
+
+def _arrival_times(records_by_rank: Mapping[int, List[dict]],
+                   kind: str) -> Dict[float, Dict[int, float]]:
+    out: Dict[float, Dict[int, float]] = {}
+    for (k, step), per_rank in _index_by_step(
+            records_by_rank, (kind,)).items():
+        times = {r: float(rec["time"]) for r, rec in per_rank.items()
+                 if isinstance(rec.get("time"), (int, float))}
+        if times:
+            out[step] = times
+    return out
+
+
+def pick_straggler_kind(records_by_rank: Mapping[int, List[dict]],
+                        preferred: Sequence[str] = ("obs", "train")
+                        ) -> Optional[str]:
+    """The densest per-step stream present on >= 2 ranks wins — obs
+    records usually fire more often than train records."""
+    for kind in preferred:
+        times = _arrival_times(records_by_rank, kind)
+        if times and max(len(t) for t in times.values()) >= 2:
+            return kind
+    for kind in preferred:  # 1-rank fleet: still produce (empty-lag) rows
+        if _arrival_times(records_by_rank, kind):
+            return kind
+    return None
+
+
+def straggler_rows(records_by_rank: Mapping[int, List[dict]],
+                   kind: Optional[str] = None,
+                   monitor: Optional[AnomalyMonitor] = None
+                   ) -> Tuple[List[dict], List[dict]]:
+    """Per-step slowest-rank attribution + persistence classification.
+
+    Returns (rows, events). Each row: which rank arrived last at that
+    step's record, its lag behind the median arrival, and whether its
+    EWMA lag marks it persistent (the same host every step) or transient
+    (GC pause, one slow input batch). ``monitor`` carries the EWMA state
+    and the ``straggler_persistent`` rule — pass the trainer's monitor
+    (halt_on set) to make a persistent straggler fail fast; the default
+    records only.
+    """
+    kind = kind or pick_straggler_kind(records_by_rank)
+    if kind is None:
+        return [], []
+    by_step = _arrival_times(records_by_rank, kind)
+    steps = sorted(by_step)
+    med_arrivals = [_median(list(by_step[s].values())) for s in steps]
+    diffs = sorted(b - a for a, b in zip(med_arrivals, med_arrivals[1:]))
+    step_dur = diffs[len(diffs) // 2] if diffs else None
+
+    monitor = monitor or AnomalyMonitor()
+    rows: List[dict] = []
+    for step in steps:
+        times = by_step[step]
+        if len(times) < 2:
+            continue
+        med = _median(list(times.values()))
+        lags = {r: t - min(times.values()) for r, t in times.items()}
+        slowest = max(times, key=times.get)
+        events_before = len(monitor.events)
+        monitor.observe_ranks(step, lags, step_dur=step_dur)
+        fired = monitor.events[events_before:]
+        rows.append({
+            "src": kind, "step": step, "field": "straggler",
+            "n_ranks": len(times),
+            "slowest_rank": slowest,
+            "behind_median_s": times[slowest] - med,
+            "lag_s": lags[slowest],
+            "ewma_lag_s": monitor.rank_lag_ewma.get(slowest, 0.0),
+            "persistent": any(ev["rule"] == "straggler_persistent"
+                              for ev in fired),
+        })
+    return rows, list(monitor.events)
+
+
+def merge(targets: Sequence[str],
+          kinds: Sequence[str] = DEFAULT_KINDS,
+          straggler_kind: Optional[str] = None,
+          monitor: Optional[AnomalyMonitor] = None,
+          allow_mismatch: bool = False) -> Dict[str, Any]:
+    """One-call fleet merge: resolve + load + validate shards, build the
+    merged stat rows and the straggler attribution. Raises on unreadable
+    targets, duplicate ranks, and config_hash mismatch (see
+    validate_shards); AnomalyHalt propagates when ``monitor`` has
+    ``halt_on`` set and a persistent straggler fires."""
+    shards = resolve_targets(targets)
+    records_by_rank, bad = load_shards(shards)
+    manifest = validate_shards(records_by_rank,
+                               allow_mismatch=allow_mismatch)
+    rows = fleet_rows(records_by_rank, kinds=kinds)
+    stragglers, events = straggler_rows(
+        records_by_rank, kind=straggler_kind, monitor=monitor)
+    return {
+        "shards": {r: shards[r] for r in sorted(shards)},
+        "ranks": sorted(shards),
+        "n_malformed": bad,
+        "manifest": manifest,
+        "rows": rows,
+        "stragglers": stragglers,
+        "events": events,
+    }
+
+
+def row_record(row: dict) -> dict:
+    """A merged row as MetricsLogger-loggable fields (kind="fleet"):
+    drops nothing — the skew dict is JSON-native — but guards against
+    key collisions with the logger's own meta fields."""
+    return {k: v for k, v in row.items() if k not in ("kind", "time",
+                                                      "rank")}
+
+
+def fleet_shard_name(rank: int) -> str:
+    """Re-export so callers needing the naming contract import one
+    module (the merger) rather than reaching into utils."""
+    return shard_filename(rank)

@@ -1,0 +1,292 @@
+"""Comm-model ledger: measured T_comm / wire bytes vs the alpha-beta model.
+
+The paper's scaling argument (arXiv:1901.04359 §3, re-parameterized in
+``benchmarks/scaling_model.py``) predicts per-step communication time
+from mode, worker count, gradient size and link constants. PRs 1–3 made
+the MEASURED side observable — per-rank ``attr`` records carry the
+profiler-derived T_comm split, ``obs`` counter records carry the achieved
+wire_bytes — but nothing ever reconciled the two. This module does the
+join: for every rank (and step, where attribution is per-step) it emits a
+predicted-vs-measured ratio row, so the report can say "comm is 1.8x the
+alpha-beta model on ranks 3–4" instead of leaving both numbers in
+separate files.
+
+Reading a ratio:
+  ~1       the model explains the wire — imbalance hunting should look
+           at compute/input, not the collective
+  >>1      measured comm far above model: congestion, a straggling host
+           serializing the tree rounds, or link constants that flatter
+           the hardware (re-run benchmarks/dcn_probe.py and feed its
+           alpha_beta_fit back in)
+  <1       model too pessimistic (overlap the model ignores, or compute
+           classified as comm leaked out of attribution)
+
+Model constants come from, in priority order: explicit arguments, a
+``dcn_probe`` artifact's ``alpha_beta_fit`` (``load_alpha_beta``), and
+the scaling model's documented defaults. The scaling model itself is
+loaded from ``benchmarks/`` by path (benchmarks is not a package); when
+the benchmarks tree is absent (installed-package use) a self-contained
+pure alpha-beta fallback keeps the ledger functional.
+"""
+
+from __future__ import annotations
+
+import glob
+import importlib.util
+import json
+import math
+import os
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+# scaling_model.py main() defaults — mirrored here for the fallback path
+# and for callers that pass no constants at all.
+DEFAULT_ICI_GBPS = 1600.0
+DEFAULT_DCN_GBPS = 25.0
+
+
+def _load_scaling_model():
+    """Import benchmarks/scaling_model.py by path (repo root is 3 hops
+    up from this file); None when the benchmarks tree is absent."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(repo, "benchmarks", "scaling_model.py")
+    if not os.path.exists(path):
+        return None
+    spec = importlib.util.spec_from_file_location("_obs_scaling_model",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)
+    except Exception:
+        return None
+    return mod
+
+
+def _tree_rounds_fallback(p: int) -> int:
+    if p <= 1:
+        return 0
+    m = 1 << (p.bit_length() - 1)
+    return (m.bit_length() - 1) + (0 if m == p else 2)
+
+
+def predict_comm_ms(mode: str, p: int, *, n: int, k: int,
+                    alpha_ms: float = 0.0,
+                    beta_gbps: float = DEFAULT_DCN_GBPS,
+                    ici_gbps: float = DEFAULT_ICI_GBPS,
+                    ici_size: int = 1) -> float:
+    """Predicted comm_ms via scaling_model.predict when benchmarks/ is
+    importable, else a pure alpha-beta tree model (rounds x alpha +
+    bytes/beta on the slow link) — the degenerate ici_size=1 case of the
+    full model, which is exactly the multi-process CPU/DCN topology the
+    ledger's tests and typical --multihost runs live on."""
+    sm = _load_scaling_model()
+    if sm is not None and hasattr(sm, "predict"):
+        return sm.predict(mode, p, n=n, k=k, ici_gbps=ici_gbps,
+                          dcn_gbps=beta_gbps, ici_size=ici_size,
+                          dcn_alpha_ms=alpha_ms)
+    beta_Bps = beta_gbps * 1e9 / 8
+    wire_mode = "gtopk" if mode == "gtopk_layerwise" else mode
+    if wire_mode == "dense":
+        bytes_per_dev = 2.0 * (p - 1) / p * 4 * n if p > 1 else 0.0
+        return (bytes_per_dev / beta_Bps * 1e3
+                + 2 * (p - 1) * alpha_ms)
+    rounds = _tree_rounds_fallback(p)
+    if wire_mode == "gtopk":
+        return rounds * ((8 * k) / beta_Bps * 1e3 + alpha_ms)
+    if wire_mode == "allgather":
+        return ((8 * k * (p - 1)) / beta_Bps * 1e3
+                + (p - 1) * alpha_ms)
+    if wire_mode == "gtopk_hier":
+        return rounds * ((8 * k) / beta_Bps * 1e3 + alpha_ms)
+    raise ValueError(mode)
+
+
+def load_alpha_beta(search_dir: Optional[str] = None,
+                    nprocs: Optional[int] = None
+                    ) -> Optional[Dict[str, float]]:
+    """The fitted {alpha_ms, beta_gbps} from a dcn_probe artifact
+    (``dcn_probe_{n}proc.json``), or None. ``nprocs`` picks the exact
+    artifact; otherwise the largest proc count present wins (closest to
+    a real fleet). Default search dir: benchmarks/results/."""
+    if search_dir is None:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        search_dir = os.path.join(repo, "benchmarks", "results")
+    if nprocs is not None:
+        paths = [os.path.join(search_dir, f"dcn_probe_{nprocs}proc.json")]
+    else:
+        paths = sorted(
+            glob.glob(os.path.join(search_dir, "dcn_probe_*proc.json")),
+            key=lambda pth: os.path.basename(pth), reverse=True)
+    for path in paths:
+        try:
+            with open(path) as fh:
+                fit = json.load(fh).get("alpha_beta_fit") or {}
+        except (OSError, json.JSONDecodeError):
+            continue
+        alpha, beta = fit.get("alpha_ms"), fit.get("beta_gbps")
+        if isinstance(alpha, (int, float)) and isinstance(
+                beta, (int, float)) and beta > 0:
+            return {"alpha_ms": float(alpha), "beta_gbps": float(beta),
+                    "source": os.path.basename(path)}
+    return None
+
+
+def _manifest_params(manifest: Optional[Mapping[str, Any]]
+                     ) -> Optional[Dict[str, Any]]:
+    """(mode, p, n, k) from a run-manifest record; None when the header
+    lacks what the model needs."""
+    if not manifest:
+        return None
+    mode = manifest.get("compression")
+    p = manifest.get("nworkers")
+    n = manifest.get("num_params")
+    if not mode or not isinstance(p, int) or not isinstance(n, int):
+        return None
+    rho = manifest.get("density")
+    k = (max(1, math.ceil(rho * n))
+         if isinstance(rho, (int, float)) and rho > 0 else n)
+    if mode == "dense":
+        k = n
+    return {"mode": str(mode), "p": p, "n": n, "k": k}
+
+
+def ledger_rows(records: Sequence[Mapping[str, Any]],
+                manifest: Optional[Mapping[str, Any]] = None,
+                alpha_ms: Optional[float] = None,
+                beta_gbps: Optional[float] = None,
+                ici_gbps: float = DEFAULT_ICI_GBPS,
+                ici_size: Optional[int] = None,
+                probe_dir: Optional[str] = None) -> List[dict]:
+    """The join: one ratio row per measured T_comm observation.
+
+    ``records`` is a merged (or single-shard) record stream; the
+    manifest (explicit, or found in-stream) supplies the model inputs;
+    ``attr`` records supply measured per-rank T_comm (t_comm_us) and the
+    ``obs`` counter records supply measured wire_bytes per step. Fitted
+    alpha/beta default to the newest dcn_probe artifact when present.
+    Returns [] rather than guessing when the manifest can't parameterize
+    the model.
+    """
+    if manifest is None:
+        for rec in records:
+            if rec.get("kind") == "manifest":
+                manifest = rec
+                break
+    params = _manifest_params(manifest)
+    if params is None:
+        return []
+
+    fit_source = "defaults"
+    if alpha_ms is None or beta_gbps is None:
+        fit = load_alpha_beta(search_dir=probe_dir)
+        if fit is not None:
+            alpha_ms = fit["alpha_ms"] if alpha_ms is None else alpha_ms
+            beta_gbps = (fit["beta_gbps"] if beta_gbps is None
+                         else beta_gbps)
+            fit_source = fit["source"]
+    alpha_ms = 0.0 if alpha_ms is None else float(alpha_ms)
+    beta_gbps = (DEFAULT_DCN_GBPS if beta_gbps is None
+                 else float(beta_gbps))
+
+    if ici_size is None:
+        # Cross-process hops are the slow link; devices per process is
+        # the natural ICI-domain size. process_count is in the manifest
+        # since PR 2; absent (or single-process) means every hop is
+        # "DCN" for the fallback topology, which is the conservative
+        # read for a ledger about the slow link.
+        pc = manifest.get("process_count") if manifest else None
+        if isinstance(pc, int) and pc > 1 and params["p"] % pc == 0:
+            ici_size = params["p"] // pc
+        else:
+            ici_size = 1
+
+    predicted_ms = predict_comm_ms(
+        params["mode"], params["p"], n=params["n"], k=params["k"],
+        alpha_ms=alpha_ms, beta_gbps=beta_gbps, ici_gbps=ici_gbps,
+        ici_size=ici_size)
+
+    base = {
+        "mode": params["mode"], "p": params["p"],
+        "n": params["n"], "k": params["k"],
+        "alpha_ms": round(alpha_ms, 6), "beta_gbps": round(beta_gbps, 6),
+        "ici_size": ici_size, "fit_source": fit_source,
+        "predicted_comm_ms": round(predicted_ms, 6),
+    }
+    rows: List[dict] = []
+    for rec in records:
+        kind = rec.get("kind")
+        rank = rec.get("rank", 0)
+        if kind == "attr":
+            t_comm_us = rec.get("t_comm_us")
+            if not isinstance(t_comm_us, (int, float)):
+                continue
+            measured_ms = float(t_comm_us) / 1e3
+            n_steps = rec.get("n_steps")
+            if isinstance(n_steps, (int, float)) and n_steps > 0:
+                measured_ms /= float(n_steps)
+            rows.append({
+                **base, "source": "attr", "rank": rank,
+                "step": rec.get("step"),
+                "measured_comm_ms": round(measured_ms, 6),
+                "ratio": (round(measured_ms / predicted_ms, 6)
+                          if predicted_ms > 0 else None),
+            })
+        elif kind == "obs":
+            wire = rec.get("wire_bytes")
+            if not isinstance(wire, (int, float)) or wire <= 0:
+                continue
+            # Bytes-side sanity row: achieved wire bytes vs the model's
+            # per-device volume (8k per sparse round; dense ring 2(p-1)/p
+            # x 4n). No timing — the ratio checks volume accounting, the
+            # attr rows check time.
+            p, nn, k = params["p"], params["n"], params["k"]
+            wm = ("gtopk" if params["mode"] == "gtopk_layerwise"
+                  else params["mode"])
+            if wm == "dense":
+                pred_bytes = 2.0 * (p - 1) / p * 4 * nn if p > 1 else 0.0
+            elif wm in ("gtopk", "gtopk_hier"):
+                pred_bytes = _tree_rounds_fallback(
+                    p if wm == "gtopk" else max(1, p // ici_size)) * 8 * k
+            elif wm == "allgather":
+                pred_bytes = 8 * k * (p - 1)
+            else:
+                pred_bytes = 0.0
+            rows.append({
+                **base, "source": "wire_bytes", "rank": rank,
+                "step": rec.get("step"),
+                "measured_wire_bytes": float(wire),
+                "predicted_wire_bytes": round(pred_bytes, 1),
+                "ratio": (round(float(wire) / pred_bytes, 6)
+                          if pred_bytes > 0 else None),
+            })
+    return rows
+
+
+def summarize_ledger(rows: Sequence[Mapping[str, Any]]
+                     ) -> Dict[str, Dict[str, Any]]:
+    """{source: {count, mean_ratio, min_ratio, max_ratio, worst_ranks}}
+    — the report's one-glance view; worst_ranks are the ranks whose mean
+    ratio sits highest (the "ranks 3–4" in the module docstring)."""
+    by_source: Dict[str, List[Mapping[str, Any]]] = {}
+    for row in rows:
+        if isinstance(row.get("ratio"), (int, float)):
+            by_source.setdefault(str(row.get("source")), []).append(row)
+    out: Dict[str, Dict[str, Any]] = {}
+    for source, rws in by_source.items():
+        ratios = [float(r["ratio"]) for r in rws]
+        by_rank: Dict[Any, List[float]] = {}
+        for r in rws:
+            by_rank.setdefault(r.get("rank", 0), []).append(
+                float(r["ratio"]))
+        rank_means = {rk: sum(v) / len(v) for rk, v in by_rank.items()}
+        worst = sorted(rank_means, key=rank_means.get, reverse=True)[:2]
+        out[source] = {
+            "count": len(ratios),
+            "mean_ratio": round(sum(ratios) / len(ratios), 4),
+            "min_ratio": round(min(ratios), 4),
+            "max_ratio": round(max(ratios), 4),
+            "worst_ranks": {str(rk): round(rank_means[rk], 4)
+                            for rk in worst},
+        }
+    return out

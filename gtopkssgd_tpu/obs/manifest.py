@@ -74,6 +74,19 @@ def git_sha(repo_dir: Optional[str] = None) -> Optional[str]:
         return None
 
 
+def coordinator_address() -> Optional[str]:
+    """The jax.distributed coordinator this process joined, or None for
+    single-process runs. Read from jax's internal distributed state —
+    there is no public accessor — so failures of any shape degrade to
+    None rather than killing the run for a header field."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.coordinator_address
+    except Exception:
+        return None
+
+
 def run_manifest(config: Any = None, mesh=None, **extra) -> Dict[str, Any]:
     """Assemble the manifest record body (no "kind"/"time" — the metrics
     logger adds those). ``config`` is any dataclass or mapping;
@@ -107,9 +120,15 @@ def run_manifest(config: Any = None, mesh=None, **extra) -> Dict[str, Any]:
         man["device_kind"] = jax.devices()[0].device_kind
         man["device_count"] = jax.device_count()
         man["process_count"] = jax.process_count()
+        # WHICH process wrote this shard — with process_count and the
+        # coordinator address, the fleet merger can confirm that shards
+        # in one dir really are one distributed run (config_hash is the
+        # primary join key; these make mismatch errors explainable).
+        man["process_index"] = jax.process_index()
     except Exception:
         # A dead accelerator tunnel must not kill the run for a header.
         man.setdefault("backend", None)
+    man["coordinator_address"] = coordinator_address()
     man["git_sha"] = git_sha()
     man.update(extra)
     return man

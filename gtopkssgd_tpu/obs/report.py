@@ -9,16 +9,26 @@ or gate one against a committed baseline.
                                                         # T_comm decomposition
     python -m gtopkssgd_tpu.obs.report events <run>     # anomaly events by rule
     python -m gtopkssgd_tpu.obs.report timeline <run>   # rebuild timeline.json
+    python -m gtopkssgd_tpu.obs.report fleet <run>...   # cross-rank merge +
+                                                        # straggler attribution
+    python -m gtopkssgd_tpu.obs.report watch <run>...   # live tail-follow
+    python -m gtopkssgd_tpu.obs.report ledger <run>...  # comm model vs measured
 
 A <run> is a directory containing metrics.jsonl (what --out-dir produces)
-or a path to any .jsonl file of MetricsLogger records. Records group by
-their ``kind`` ("train", "eval", "obs", "spans", "epoch", ...); every
-numeric field gets count/mean/min/max/last. When the run has a manifest
-header it is printed first, and "layers" records additionally get a
-per-layer breakdown table (one row per layer, mean of each
-counters.LAYER_FIELDS column). The two-run mode prints mean vs. mean with
-a signed delta per field — the bench-regression triage view (was r05
-slower because comm grew, or because achieved density drifted?).
+or a path to any .jsonl file of MetricsLogger records. Multi-process runs
+shard per rank (``metrics.rank{r}.jsonl``, utils/metrics.py): a directory
+holding shards but no metrics.jsonl loads as the concatenation of all its
+shards, so every subcommand — including the two-run compare, whose means
+over concatenated shards ARE the fleet-merged means — works on fleet
+dirs unchanged. Records group by their ``kind`` ("train", "eval", "obs",
+"spans", "epoch", ...); every numeric field gets count/mean/min/max/last.
+When the run has a manifest header it is printed first, and "layers"
+records additionally get a per-layer breakdown table (one row per layer,
+mean of each counters.LAYER_FIELDS column). The two-run mode prints mean
+vs. mean with a signed delta per field — the bench-regression triage view
+(was r05 slower because comm grew, or because achieved density drifted?).
+Kinds not registered in utils.metrics.KINDS are flagged with a note
+(records from a future/modified writer, or hand-edited files).
 
 ``gate`` is the regression gate: the baseline JSON carries a ``checks``
 list ({kind, field, stat, expect, rtol, atol, optional layer}) and an
@@ -38,38 +48,90 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import time as _time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from gtopkssgd_tpu.utils.metrics import KINDS, shard_rank
 
 # Bookkeeping fields that are not measurements; excluded from aggregation.
 _META_FIELDS = {"kind", "time", "rank"}
 
 
 def resolve_path(run: str) -> str:
-    """<run dir> -> its metrics.jsonl; a file path passes through."""
+    """<run dir> -> its metrics.jsonl; a file path passes through. When
+    the dir has only rank shards, rank 0's shard is the representative
+    single path (use resolve_paths for the whole fleet)."""
     if os.path.isdir(run):
-        return os.path.join(run, "metrics.jsonl")
+        single = os.path.join(run, "metrics.jsonl")
+        if os.path.exists(single):
+            return single
+        shards = _shard_paths(run)
+        if shards:
+            return shards[0]
+        return single
     return run
 
 
-def load_records(run: str) -> Tuple[List[dict], int]:
-    """Parse a run's records. Returns (records, n_malformed)."""
-    path = resolve_path(run)
+def _shard_paths(run_dir: str) -> List[str]:
+    """metrics.rank{r}.jsonl shards in a dir, sorted by rank."""
+    found = []
+    for name in os.listdir(run_dir):
+        r = shard_rank(name)
+        if r is not None:
+            found.append((r, os.path.join(run_dir, name)))
+    return [path for _, path in sorted(found)]
+
+
+def resolve_paths(run: str) -> List[str]:
+    """Every record file a run target names: [metrics.jsonl] for classic
+    runs, all rank shards (rank order) for sharded dirs, the file itself
+    for file paths."""
+    if os.path.isdir(run):
+        single = os.path.join(run, "metrics.jsonl")
+        if os.path.exists(single):
+            return [single]
+        shards = _shard_paths(run)
+        return shards if shards else [single]
+    return [run]
+
+
+def _parse_lines(lines: Iterable[str]) -> Tuple[List[dict], int]:
     records, bad = [], 0
-    with open(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                bad += 1
-                continue
-            if isinstance(rec, dict):
-                records.append(rec)
-            else:
-                bad += 1
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            bad += 1
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+        else:
+            bad += 1
     return records, bad
+
+
+def load_records(run: str) -> Tuple[List[dict], int]:
+    """Parse a run's records — concatenating rank shards (rank order)
+    when the target is a sharded dir, so aggregate means over a fleet
+    dir ARE the fleet-merged means. Returns (records, n_malformed)."""
+    records, bad = [], 0
+    for path in resolve_paths(run):
+        with open(path) as fh:
+            recs, b = _parse_lines(fh)
+        records.extend(recs)
+        bad += b
+    return records, bad
+
+
+def unregistered_kinds(records: Iterable[dict]) -> List[str]:
+    """Kinds present in a record stream but missing from the writer's
+    registry (utils.metrics.KINDS) — a hand-edited file or a
+    version-skewed writer; flagged, never fatal."""
+    return sorted({str(rec.get("kind")) for rec in records
+                   if rec.get("kind") not in KINDS})
 
 
 def summarize(records: Iterable[dict]) -> Dict[str, Dict[str, dict]]:
@@ -341,7 +403,8 @@ def run_gate(run: str, baseline_path: str,
 def _is_run(target: str) -> bool:
     """Does the target look like a metrics run (vs. a profiler trace)?"""
     if os.path.isdir(target):
-        return os.path.exists(os.path.join(target, "metrics.jsonl"))
+        return (os.path.exists(os.path.join(target, "metrics.jsonl"))
+                or bool(_shard_paths(target)))
     return target.endswith(".jsonl")
 
 
@@ -485,6 +548,222 @@ def run_timeline(run: str, out: Optional[str] = None) -> int:
     return 1 if problems else 0
 
 
+def format_fleet(merged: dict, kinds: Optional[Sequence[str]] = None,
+                 max_rows: int = 0) -> str:
+    """The fleet view: per-(src, step, field) stat rows, then straggler
+    attribution, then fired events. ``max_rows`` > 0 truncates the stat
+    table (watch mode); 0 prints everything."""
+    chunks = [f"fleet: ranks={merged['ranks']} "
+              f"shards={len(merged['shards'])}"]
+    man = merged.get("manifest") or {}
+    if man:
+        bits = [f"{key}={man[key]}" for key in
+                ("compression", "nworkers", "process_count", "config_hash")
+                if man.get(key) is not None]
+        if bits:
+            chunks.append("  " + "  ".join(bits))
+    rows = merged["rows"]
+    if kinds:
+        rows = [r for r in rows if r["src"] in kinds]
+    table = []
+    shown = rows if max_rows <= 0 else rows[-max_rows:]
+    for r in shown:
+        worst = (max(r["skew"], key=lambda rk: abs(r["skew"][rk]))
+                 if r["skew"] else "-")
+        table.append([r["src"], _fmt(r["step"]), r["field"],
+                      str(r["n_ranks"]), _fmt(r["min"]), _fmt(r["median"]),
+                      _fmt(r["max"]), _fmt(r["std"]), _fmt(r["skew_max"]),
+                      str(worst)])
+    if table:
+        chunks.append(f"\n[fleet] ({len(rows)} merged rows"
+                      + (f", last {len(shown)}" if len(shown) < len(rows)
+                         else "") + ")")
+        chunks.append(_table(table, ["src", "step", "field", "n_ranks",
+                                     "min", "median", "max", "std",
+                                     "skew_max", "worst"]))
+    stragglers = merged.get("stragglers") or []
+    if stragglers:
+        st = [[_fmt(s["step"]), f"r{s['slowest_rank']}",
+               _fmt(s["behind_median_s"]), _fmt(s["lag_s"]),
+               _fmt(s["ewma_lag_s"]),
+               "persistent" if s["persistent"] else "transient"]
+              for s in stragglers]
+        chunks.append(f"\n[straggler] (src={stragglers[0]['src']}; lag = "
+                      "arrival behind first rank at each step's record)")
+        chunks.append(_table(st, ["step", "slowest", "behind_median_s",
+                                  "lag_s", "ewma_lag_s", "class"]))
+        persistent = [s for s in stragglers if s["persistent"]]
+        if persistent:
+            worst = persistent[-1]
+            chunks.append(
+                f"persistent straggler: rank {worst['slowest_rank']} "
+                f"(EWMA lag {_fmt(worst['ewma_lag_s'])}s over "
+                f"{len(persistent)} flagged steps)")
+    events = merged.get("events") or []
+    if events:
+        by_rule: Dict[str, int] = {}
+        for ev in events:
+            by_rule[ev["rule"]] = by_rule.get(ev["rule"], 0) + 1
+        chunks.append("\n[events] "
+                      + "  ".join(f"{rule}={n}"
+                                  for rule, n in sorted(by_rule.items())))
+    return "\n".join(chunks)
+
+
+def run_fleet(targets: Sequence[str], kinds: Optional[Sequence[str]],
+              json_out: Optional[str] = None,
+              allow_mismatch: bool = False) -> int:
+    """``fleet`` subcommand: merge rank shards (one or many dirs/files),
+    print per-step cross-rank stats + straggler attribution."""
+    from gtopkssgd_tpu.obs import fleet
+
+    try:
+        merged = fleet.merge(list(targets),
+                             kinds=tuple(kinds) if kinds
+                             else fleet.DEFAULT_KINDS,
+                             allow_mismatch=allow_mismatch)
+    except (OSError, ValueError) as e:
+        print(f"cannot merge {list(targets)}: {e}")
+        return 2
+    if merged["n_malformed"]:
+        print(f"note: skipped {merged['n_malformed']} malformed line(s)")
+    print(format_fleet(merged, kinds=None))
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump(merged, fh, indent=1, sort_keys=True, default=str)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0
+
+
+def run_watch(targets: Sequence[str], interval: float = 2.0,
+              iterations: Optional[int] = None, out=None) -> int:
+    """``watch`` subcommand: tail-follow one or many shards, printing a
+    refreshing per-rank summary block per poll. Incremental — each poll
+    reads only bytes appended since the last (line-buffered writers make
+    whole records visible mid-run). ``iterations`` bounds the loop for
+    tests/scripting; the default runs until interrupted."""
+    import sys
+    out = out or sys.stdout
+
+    # rank -> [path, offset, n_records, n_bad, last_rec_by_kind]
+    state: Dict[int, list] = {}
+
+    def discover():
+        for target in targets:
+            if os.path.isdir(target):
+                for path in _shard_paths(target) or [
+                        os.path.join(target, "metrics.jsonl")]:
+                    r = shard_rank(path)
+                    state.setdefault(r if r is not None else 0,
+                                     [path, 0, 0, 0, {}])
+            else:
+                r = shard_rank(target)
+                state.setdefault(r if r is not None else 0,
+                                 [target, 0, 0, 0, {}])
+
+    n_polls = 0
+    try:
+        while True:
+            discover()  # shards appear as ranks start up
+            for rank in sorted(state):
+                st = state[rank]
+                path, offset = st[0], st[1]
+                try:
+                    with open(path) as fh:
+                        fh.seek(offset)
+                        chunk = fh.read()
+                        st[1] = fh.tell()
+                except OSError:
+                    continue
+                recs, bad = _parse_lines(chunk.splitlines())
+                st[2] += len(recs)
+                st[3] += bad
+                for rec in recs:
+                    st[4][str(rec.get("kind"))] = rec
+            stamp = _time.strftime("%H:%M:%S")
+            print(f"watch @ {stamp}  ({len(state)} rank(s))", file=out)
+            for rank in sorted(state):
+                path, _, n, bad, last = state[rank]
+                latest = None
+                for kind in ("train", "obs", "eval"):
+                    if kind in last:
+                        latest = last[kind]
+                        break
+                bits = [f"rank {rank}", f"records={n}"]
+                if latest is not None:
+                    if latest.get("step") is not None:
+                        bits.append(f"step={_fmt(latest['step'])}")
+                    for key in ("loss", "achieved_density", "wire_bytes"):
+                        if isinstance(latest.get(key), (int, float)):
+                            bits.append(f"{key}={_fmt(latest[key])}")
+                ev = last.get("event")
+                if ev is not None:
+                    bits.append(f"last_event={ev.get('rule')}")
+                if bad:
+                    bits.append(f"malformed={bad}")
+                if n == 0:
+                    bits.append("(no records yet)")
+                print("  " + "  ".join(bits), file=out)
+            out.flush()
+            n_polls += 1
+            if iterations is not None and n_polls >= iterations:
+                return 0
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def run_ledger(targets: Sequence[str], json_out: Optional[str] = None,
+               alpha_ms: Optional[float] = None,
+               beta_gbps: Optional[float] = None,
+               probe_dir: Optional[str] = None) -> int:
+    """``ledger`` subcommand: predicted-vs-measured comm rows over a
+    run's (or fleet's) records."""
+    from gtopkssgd_tpu.obs import ledger
+
+    records = []
+    for target in targets:
+        try:
+            recs, bad = load_records(target)
+        except OSError as e:
+            print(f"cannot read {target}: {e}")
+            return 2
+        if bad:
+            print(f"note: {target}: skipped {bad} malformed line(s)")
+        records.extend(recs)
+    rows = ledger.ledger_rows(records, alpha_ms=alpha_ms,
+                              beta_gbps=beta_gbps, probe_dir=probe_dir)
+    if not rows:
+        print("ledger: no joinable records (need a manifest with "
+              "compression/nworkers/num_params plus attr or obs "
+              "wire_bytes records)")
+        return 1
+    base = rows[0]
+    print(f"ledger: mode={base['mode']} p={base['p']} n={base['n']} "
+          f"k={base['k']}  alpha_ms={base['alpha_ms']} "
+          f"beta_gbps={base['beta_gbps']} ici_size={base['ici_size']} "
+          f"(fit: {base['fit_source']})")
+    print(f"predicted comm: {_fmt(base['predicted_comm_ms'])} ms/step")
+    summary = ledger.summarize_ledger(rows)
+    table = []
+    for source in sorted(summary):
+        s = summary[source]
+        worst = "  ".join(f"r{rk}={v}" for rk, v in
+                          s["worst_ranks"].items())
+        table.append([source, str(s["count"]), _fmt(s["mean_ratio"]),
+                      _fmt(s["min_ratio"]), _fmt(s["max_ratio"]), worst])
+    print(_table(table, ["source", "rows", "mean_ratio", "min_ratio",
+                         "max_ratio", "worst_ranks"]))
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump({"rows": rows, "summary": summary}, fh, indent=1,
+                      sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0
+
+
 def build_gate_argparser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         "gtopkssgd_tpu.obs.report gate",
@@ -558,6 +837,64 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="output path (default: <run>/timeline.json)")
         a = ap.parse_args(argv[1:])
         return run_timeline(a.run, out=a.out)
+    if argv and argv[0] == "fleet":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report fleet",
+            description="Merge per-rank metric shards into per-step "
+                        "cross-rank stats (min/median/max/std + skew) "
+                        "with slowest-rank straggler attribution.")
+        ap.add_argument("targets", nargs="+",
+                        help="run dirs holding metrics.rank*.jsonl (or "
+                             "metrics.jsonl), or shard paths")
+        ap.add_argument("--kinds", default=None,
+                        help="comma-separated source kinds to merge "
+                             "(default: obs,train,spans)")
+        ap.add_argument("--json", dest="json_out", default=None)
+        ap.add_argument("--allow-mismatch", action="store_true",
+                        help="merge shards even when their manifest "
+                             "config_hash differs (normally refused)")
+        a = ap.parse_args(argv[1:])
+        kinds = ([k.strip() for k in a.kinds.split(",") if k.strip()]
+                 if a.kinds else None)
+        return run_fleet(a.targets, kinds, json_out=a.json_out,
+                         allow_mismatch=a.allow_mismatch)
+    if argv and argv[0] == "watch":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report watch",
+            description="Tail-follow live shards with a refreshing "
+                        "per-rank summary (Ctrl-C to stop).")
+        ap.add_argument("targets", nargs="+",
+                        help="run dirs or shard paths to follow")
+        ap.add_argument("--interval", type=float, default=2.0,
+                        help="seconds between polls (default 2)")
+        ap.add_argument("--iterations", type=int, default=None,
+                        help="stop after N polls (default: forever)")
+        a = ap.parse_args(argv[1:])
+        return run_watch(a.targets, interval=a.interval,
+                         iterations=a.iterations)
+    if argv and argv[0] == "ledger":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report ledger",
+            description="Join measured per-step comm (attr t_comm_us, "
+                        "obs wire_bytes) against the alpha-beta scaling "
+                        "model; ratios ~1 mean the model explains the "
+                        "wire.")
+        ap.add_argument("targets", nargs="+",
+                        help="run dirs or record files (fleet dirs ok)")
+        ap.add_argument("--alpha-ms", type=float, default=None,
+                        help="per-message latency override (default: "
+                             "newest dcn_probe artifact, else 0)")
+        ap.add_argument("--beta-gbps", type=float, default=None,
+                        help="slow-link bandwidth override (default: "
+                             "newest dcn_probe artifact, else 25)")
+        ap.add_argument("--probe-dir", default=None,
+                        help="where to look for dcn_probe_*proc.json "
+                             "(default benchmarks/results/)")
+        ap.add_argument("--json", dest="json_out", default=None)
+        a = ap.parse_args(argv[1:])
+        return run_ledger(a.targets, json_out=a.json_out,
+                          alpha_ms=a.alpha_ms, beta_gbps=a.beta_gbps,
+                          probe_dir=a.probe_dir)
     args = build_argparser().parse_args(argv)
     if len(args.runs) > 2:
         print("at most 2 runs (one to summarize, two to compare)")
@@ -576,6 +913,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         all_records.append(records)
         if bad:
             print(f"note: {run}: skipped {bad} malformed line(s)")
+        unknown = unregistered_kinds(records)
+        if unknown:
+            print(f"note: {run}: unregistered kind(s) "
+                  f"{', '.join(unknown)} (not in utils.metrics.KINDS)")
     if len(summaries) == 1:
         manifest = extract_manifest(all_records[0])
         layers = summarize_layers(all_records[0])

@@ -177,6 +177,13 @@ class TrainConfig:
                                    # event/stall markers) here on exit
                                    # (a directory gets timeline.json
                                    # appended); None disables
+    obs_export_port: int = 0       # serve the latest metric values as
+                                   # OpenMetrics text on this localhost
+                                   # HTTP port (obs.exporter; curl
+                                   # localhost:PORT/metrics). -1 binds an
+                                   # ephemeral port (tests); 0 disables.
+                                   # Every process exports — scrape each
+                                   # host for its own rank's view
     prefetch: int = 2              # host batches assembled ahead by a
                                    # background thread (0 = synchronous;
                                    # reference C8 parity with DataLoader
@@ -276,8 +283,25 @@ class Trainer:
         self.cfg = cfg = config.resolved()
         self.process_rank = jax.process_index()
         self.logger = get_logger("trainer", rank=self.process_rank)
-        self.metrics = MetricsLogger(cfg.out_dir, self.logger,
-                                     rank=self.process_rank)
+        # Live OpenMetrics endpoint (obs.exporter): fed as the metrics
+        # sink so it sees exactly the records this rank produces, file
+        # or no file. Started before the logger so the sink exists.
+        self.exporter = None
+        if cfg.obs_export_port:
+            from gtopkssgd_tpu.obs.exporter import MetricsExporter
+
+            port = max(0, cfg.obs_export_port)
+            self.exporter = MetricsExporter(port=port).start()
+            self.logger.info(
+                "obs exporter: http://127.0.0.1:%d/metrics",
+                self.exporter.port)
+        # Multi-process runs shard per rank (metrics.rank{r}.jsonl) so
+        # the fleet merger (obs/fleet.py) has per-host streams to align;
+        # single-process keeps the classic metrics.jsonl.
+        self.metrics = MetricsLogger(
+            cfg.out_dir, self.logger, rank=self.process_rank,
+            shard=jax.process_count() > 1,
+            sink=self.exporter.observe if self.exporter else None)
         # Host timeline (obs.timeline): spans + telemetry tracks + event
         # markers as one chrome-trace JSON, written on __exit__ (and
         # best-effort on a watchdog stall). Rank 0 only, like metrics.
@@ -370,10 +394,11 @@ class Trainer:
         # flatten order — the same order the optimizer's segment map uses.
         self._layer_names = (
             layer_names(self.state.params) if cfg.obs_layers else ())
-        # Run-manifest header: first record of metrics.jsonl, so the file
-        # is self-describing (config hash + resolved headline flags, mesh,
-        # jax/backend versions, git sha). MetricsLogger is rank-0-only,
-        # matching every other record kind.
+        # Run-manifest header: first record of every metrics file, so
+        # each is self-describing (config hash + resolved headline flags,
+        # mesh, jax/backend versions, git sha). In sharded multi-process
+        # runs EVERY rank writes it — config_hash is the join key the
+        # fleet merger validates before aligning shards.
         self.metrics.log("manifest", flush=True, **run_manifest(
             cfg, mesh=self.mesh, num_params=self.num_params,
             steps_per_epoch=self.steps_per_epoch))
@@ -443,6 +468,8 @@ class Trainer:
         # The metrics file outlives close() (restore() can resume a closed
         # Trainer's training); only leaving the context ends the run.
         self.metrics.close()
+        if self.exporter is not None:
+            self.exporter.close()
 
     # ------------------------------------------------------------ watchdog
     def _stall_diagnostics(self) -> Dict[str, Any]:

@@ -11,6 +11,13 @@ own ``__exit__``. The file is opened line-buffered, so every completed
 record hits the OS on its own ``write`` — a run killed mid-step (the stall
 watchdog hard-exits, the kernel OOM-kills) loses at most the line being
 written, without paying an explicit ``flush()`` syscall per record.
+
+Multi-process runs (``shard=True``): EVERY process writes its own shard
+with the deterministic name ``metrics.rank{r}.jsonl`` in the same out
+dir, so cross-host comparison is possible at all — the fleet layer
+(obs/fleet.py) merges shards by (kind, step) and validates via each
+shard's manifest header that they belong to the same run. Single-process
+runs keep the classic ``metrics.jsonl`` (rank 0 only).
 """
 
 from __future__ import annotations
@@ -18,20 +25,66 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
+
+# Registered record kinds. Shared with the report CLI (which flags
+# unregistered kinds in a run) and enforced at log() time, so a typo'd
+# kind fails loudly instead of silently vanishing from every report;
+# tests/test_obs_fleet.py greps the tree's `.log("` call sites against
+# this set.
+KINDS = frozenset({
+    "manifest",    # run provenance header (obs/manifest.py), first record
+    "train",       # per-log-interval training stats
+    "eval",        # validation metrics
+    "epoch",       # end-of-epoch combined stats
+    "obs",         # on-device compression/comm counters (obs/counters.py)
+    "layers",      # per-layer telemetry, one record per layer per obs step
+    "spans",       # Tracer window means (obs/tracing.py flush)
+    "span",        # Tracer per-span record (record_each=True)
+    "event",       # anomaly events (obs/events.py)
+    "stall",       # watchdog stall diagnostic (obs/watchdog.py)
+    "attr",        # T_compute/T_select/T_comm split (obs/trace_attr.py)
+    "attr_error",  # attribution capture failure (gate smoke)
+    "fleet",       # cross-rank merged per-step stats (obs/fleet.py)
+    "ledger",      # predicted-vs-measured comm model rows (obs/ledger.py)
+})
+
+_SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")
+
+
+def shard_filename(rank: int) -> str:
+    """Deterministic per-rank shard name; the join key the fleet merger
+    (and a human with `ls`) recovers the rank from."""
+    return f"metrics.rank{rank}.jsonl"
+
+
+def shard_rank(path: str) -> Optional[int]:
+    """Rank encoded in a shard filename, or None for non-shard names."""
+    m = _SHARD_RE.match(os.path.basename(path))
+    return int(m.group(1)) if m else None
 
 
 class MetricsLogger:
     def __init__(self, out_dir: Optional[str] = None,
-                 logger: Optional[logging.Logger] = None, rank: int = 0):
+                 logger: Optional[logging.Logger] = None, rank: int = 0,
+                 shard: bool = False,
+                 sink: Optional[Callable[[Dict[str, Any]], None]] = None):
+        """``shard=True`` (multi-process runs) writes
+        ``metrics.rank{rank}.jsonl`` on EVERY rank; the default writes
+        ``metrics.jsonl`` on rank 0 only. ``sink`` is called with each
+        completed record (file or no file) — the live exporter's hook
+        (obs.exporter.MetricsExporter.observe matches it); sink errors
+        are swallowed so export can never take down training."""
         self.logger = logger
         self.rank = rank
+        self.sink = sink
         self._fh = None
-        if out_dir is not None and rank == 0:
+        if out_dir is not None and (shard or rank == 0):
             os.makedirs(out_dir, exist_ok=True)
-            self._fh = open(os.path.join(out_dir, "metrics.jsonl"), "a",
-                            buffering=1)
+            name = shard_filename(rank) if shard else "metrics.jsonl"
+            self._fh = open(os.path.join(out_dir, name), "a", buffering=1)
 
     def log(self, kind: str, *, flush: bool = False,
             **fields: Any) -> Dict[str, Any]:
@@ -42,6 +95,10 @@ class MetricsLogger:
         if not isinstance(kind, str) or not kind:
             raise ValueError(
                 f"metrics kind must be a non-empty str, got {kind!r}")
+        if kind not in KINDS:
+            raise ValueError(
+                f"unregistered metrics kind {kind!r}; add it to "
+                f"utils.metrics.KINDS (registered: {sorted(KINDS)})")
         rec = {"kind": kind, "time": time.time(), "rank": self.rank, **fields}
         if self._fh is not None:
             self._fh.write(json.dumps(rec) + "\n")
@@ -51,6 +108,11 @@ class MetricsLogger:
                     os.fsync(self._fh.fileno())
                 except OSError:
                     pass
+        if self.sink is not None:
+            try:
+                self.sink(rec)
+            except Exception:
+                pass
         if self.logger is not None:
             human = " ".join(
                 f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"

@@ -1,0 +1,76 @@
+"""Regenerate the committed fleet fixture shards in this directory.
+
+Three rank shards of one synthetic run (shared config_hash), steps 1-4
+at a 1.0 s cadence, with the two defects the fleet merger must handle
+baked in deterministically:
+
+  rank 2   arrives 2.5 s late at EVERY step — a persistent straggler
+           (2.5 s > the auto threshold straggler_lag_x=2 x the 1.0 s
+           step duration, and constant, so the EWMA pins at 2.5 s).
+  rank 1   is missing step 3 entirely — a ragged shard (crashed logger,
+           thinned interval); the merger must keep going with
+           n_ranks=2 at that step.
+
+Values are hand-chosen, not sampled, so test assertions are exact:
+loss at step s on rank r is 2.0 - 0.1*s + 0.01*r and wire_bytes is
+2400 on every rank (zero skew on that field, nonzero on loss).
+
+Run from anywhere:  python tests/fixtures/fleet/make_fleet_fixture.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+BASE_TIME = 1700000000.0
+STEP_S = 1.0          # wall-clock cadence of the synthetic run
+LAG_RANK = 2
+LAG_S = 2.5           # > 2.0 x STEP_S => persistent under the defaults
+MISSING = (1, 3)      # (rank, step) dropped to make shard 1 ragged
+CONFIG_HASH = "fleetfix0001beef"
+N_RANKS, STEPS = 3, (1, 2, 3, 4)
+NUM_PARAMS = 10000
+DENSITY = 0.01
+
+
+def manifest(rank: int) -> dict:
+    return {
+        "kind": "manifest", "time": BASE_TIME, "rank": rank,
+        "config_hash": CONFIG_HASH,
+        "dnn": "resnet20", "dataset": "cifar10",
+        "compression": "gtopk", "density": DENSITY,
+        "nworkers": N_RANKS, "batch_size": 4, "seed": 42,
+        "num_params": NUM_PARAMS,
+        "process_count": N_RANKS, "process_index": rank,
+        "coordinator_address": "127.0.0.1:9999",
+    }
+
+
+def obs_record(rank: int, step: int) -> dict:
+    lag = LAG_S if rank == LAG_RANK else 0.0
+    return {
+        "kind": "obs", "time": BASE_TIME + step * STEP_S + lag,
+        "rank": rank, "step": step,
+        "loss": round(2.0 - 0.1 * step + 0.01 * rank, 6),
+        "achieved_density": DENSITY,
+        "wire_bytes": 2400,
+    }
+
+
+def main() -> None:
+    for rank in range(N_RANKS):
+        path = os.path.join(HERE, f"metrics.rank{rank}.jsonl")
+        with open(path, "w") as fh:
+            fh.write(json.dumps(manifest(rank)) + "\n")
+            for step in STEPS:
+                if (rank, step) == MISSING:
+                    continue
+                fh.write(json.dumps(obs_record(rank, step)) + "\n")
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
