@@ -62,6 +62,32 @@ def smoke_config(out_dir: str):
     )
 
 
+def run_recovery_smoke(out_dir: str) -> str:
+    """Injected-fault recovery sub-run: same canonical model/compression
+    (so it reuses the persistent compile cache), 3 steps with a NaN
+    injected at step 2 and ``nan_loss=skip`` claiming the anomaly. The
+    run must exit 0 — the recovery path turning a would-be exit 44 into
+    a completed run IS the property under test. Returns its run dir
+    (a subdir, so ``resolve_paths`` on the parent never sees it)."""
+    from gtopkssgd_tpu import dist_trainer
+
+    rec_dir = os.path.join(out_dir, "recovery")
+    rc = dist_trainer.main([
+        "--dnn", "resnet20", "--batch-size", "4", "--nworkers", "2",
+        "--compression", "gtopk_layerwise", "--density", "0.01",
+        "--seed", "42", "--num-iters", "3", "--eval-batches", "1",
+        "--log-interval", "1", "--obs-interval", "1",
+        "--obs-halt-on", "error",
+        "--inject", "nan_grad@2", "--recover-policy", "nan_loss=skip",
+        "--out-dir", rec_dir,
+    ])
+    if rc != 0:
+        raise RuntimeError(
+            f"recovery smoke exited {rc} (expected 0: the nan_loss=skip "
+            f"policy should claim the injected NaN)")
+    return rec_dir
+
+
 def run_smoke(out_dir: str) -> str:
     """Train the canonical run; returns the run dir (metrics.jsonl inside).
 
@@ -73,10 +99,22 @@ def run_smoke(out_dir: str) -> str:
     fleet-merged (obs/fleet.py) and logged back as "fleet" records: on
     this single-process run the merge is a 1-rank fleet, so n_ranks is
     exactly 1 and every skew_max exactly 0 — structural invariants the
-    baseline pins, putting the merge path itself under the drift gate."""
-    from gtopkssgd_tpu.obs import fleet
+    baseline pins, putting the merge path itself under the drift gate.
+
+    Before all that, a chaos sub-run (run_recovery_smoke) exercises the
+    resilience path — injected NaN claimed by a skip policy — and its
+    inject/recovery records are grafted into this run's stream, so the
+    baseline also pins recovery structure (one firing, one recovery,
+    final_status=completed)."""
+    from gtopkssgd_tpu.obs import fleet, report
     from gtopkssgd_tpu.obs.trace_attr import attribute, capture
     from gtopkssgd_tpu.trainer import Trainer
+
+    # Chaos sub-run first (its own Trainer, its own subdir), then the
+    # main run re-logs ONLY the resilience records so the baseline can
+    # pin recovery structure without the sub-run's train/obs rows
+    # polluting the main run's value statistics.
+    rec_dir = run_recovery_smoke(out_dir)
 
     cfg = smoke_config(out_dir)
     with Trainer(cfg) as t:
@@ -98,6 +136,16 @@ def run_smoke(out_dir: str) -> str:
         merged = fleet.merge([out_dir], kinds=("obs",))
         for row in merged["rows"]:
             t.metrics.log("fleet", **fleet.row_record(row))
+        # Graft the chaos sub-run's inject/recovery records into this
+        # run's stream (re-stamped time/rank) so the gate's structural
+        # recovery checks (exactly one firing, n_recoveries, completed)
+        # read from the same metrics.jsonl as everything else.
+        rec_records, _ = report.load_records(rec_dir)
+        for r in rec_records:
+            if r.get("kind") in ("inject", "recovery"):
+                t.metrics.log(r["kind"], **{
+                    k: v for k, v in r.items()
+                    if k not in ("kind", "time", "rank")})
     return out_dir
 
 

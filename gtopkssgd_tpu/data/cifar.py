@@ -129,32 +129,45 @@ class CIFAR10Dataset:
                 f"rank shard has {len(self.partitioner)} samples < "
                 f"batch_size {batch_size} — lower batch_size or nworkers"
             )
-        self._rng = np.random.default_rng(np.random.SeedSequence([seed, rank + 1]))
+        self._seed = seed
+        self._rank = rank
 
     def steps_per_epoch(self) -> int:
         return len(self.partitioner) // self.batch_size
 
-    def _augment(self, x: np.ndarray) -> np.ndarray:
+    def _augment(self, x: np.ndarray,
+                 rng: np.random.Generator) -> np.ndarray:
         """Fused pad+crop+flip on uint8. RNG draws happen here (numpy side)
         so the C++ and fallback paths are bit-identical; the pixel work runs
         in the native library when built (gtopkssgd_tpu.native)."""
         from gtopkssgd_tpu import native
 
         b = x.shape[0]
-        ys = self._rng.integers(0, 9, b).astype(np.int32)
-        xs = self._rng.integers(0, 9, b).astype(np.int32)
-        flips = self._rng.random(b) < 0.5
+        ys = rng.integers(0, 9, b).astype(np.int32)
+        xs = rng.integers(0, 9, b).astype(np.int32)
+        flips = rng.random(b) < 0.5
         return native.cifar_augment_batch(x, ys, xs, flips)
 
     def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         """One pass over this rank's shard, in the shared per-epoch order.
-        Batches are raw uint8 either way; normalization is on-device."""
+        Batches are raw uint8 either way; normalization is on-device.
+
+        Augmentation draws come from a generator seeded by (seed, rank,
+        epoch) created HERE, so batch b of epoch e is a pure function of
+        those four values — not of how many batches some other consumer
+        (the prefetcher, a shape-probing peek, a pre-restore iterator)
+        happened to pull first. Mid-epoch checkpoint resume depends on
+        this: the trainer re-drains epoch e to the restored step and must
+        land on bit-identical batches (same idea as the ImageNet decode
+        pool's per-image (seed, split, epoch, index) keying)."""
         idx = self.partitioner.indices(epoch)
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self._seed, self._rank + 1, epoch]))
         for lo in range(0, len(idx) - self.batch_size + 1, self.batch_size):
             sel = idx[lo:lo + self.batch_size]
             x = self.images[sel]
             if self.augment:
-                x = self._augment(x)
+                x = self._augment(x, rng)
             yield {"image": x, "label": self.labels[sel]}
 
     def __iter__(self):

@@ -173,7 +173,6 @@ class ImageNetDataset:
                 f"rank shard has {len(self.partitioner)} samples < "
                 f"batch_size {batch_size} — lower batch_size or nworkers"
             )
-        self._rng = np.random.default_rng(np.random.SeedSequence([seed, rank + 1]))
         # Decode worker pool (reference C8 parity: torchvision DataLoader
         # num_workers — the measured single-core decode rate, ~280 img/s,
         # is ~25x short of one v5e chip's bs=128 appetite, so the real-data
@@ -189,9 +188,9 @@ class ImageNetDataset:
         # main thread before the Prefetcher thread exists and before the
         # first XLA dispatch, so the fork window is clean; children run
         # ONLY numpy/PIL decode, never jax (same trade torch's DataLoader
-        # defaults to on Linux). With workers the augmentation stream
-        # switches from the sequential in-process rng to per-image seeding
-        # (see _decode_seeded) so results are identical for ANY pool size.
+        # defaults to on Linux). Both the pool and the sequential path use
+        # per-image seeding (see _decode_seeded) so the stream is
+        # identical for ANY pool size and reproducible mid-epoch.
         self.decode_workers = int(decode_workers) if not self.synthetic else 0
         self._pool = (_acquire_decode_pool(self.decode_workers)
                       if self.decode_workers > 0 else None)
@@ -209,9 +208,15 @@ class ImageNetDataset:
         return len(self.partitioner) // self.batch_size
 
     # --- real-image decode path -------------------------------------------
-    def _decode(self, path: str) -> np.ndarray:
-        """Sequential in-process decode (original stream semantics)."""
-        return _decode_image(path, self.image_size, self.train, self._rng)
+    def _decode_at(self, i: int, epoch: int) -> np.ndarray:
+        """Per-image seeded decode — same (seed, split, epoch, index)
+        keying as the worker-pool path, so the sequential stream is a
+        pure function of those values too (mid-epoch resume re-drains an
+        epoch and must reproduce the crops exactly; a shared stateful rng
+        would remember every earlier consumer)."""
+        return _decode_seeded(
+            (self._paths[i], self.image_size, self.train,
+             (self._seed, _split_id(self.split), int(epoch), int(i))))
 
     def _synth_batch(self, sel: np.ndarray) -> np.ndarray:
         """Deterministic per-index generation: sample i is the same array on
@@ -246,7 +251,7 @@ class ImageNetDataset:
                 ]
                 x = np.stack(self._pool.map(_decode_seeded, jobs))
             else:
-                x = np.stack([self._decode(self._paths[i]) for i in sel])
+                x = np.stack([self._decode_at(i, epoch) for i in sel])
             yield {"image": x, "label": self._labels[sel]}
 
     def __iter__(self):

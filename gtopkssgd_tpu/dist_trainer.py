@@ -63,6 +63,26 @@ reference's only telemetry was text logs):
                                          (curl localhost:PORT/metrics);
                                          0 = off (default), -1 = ephemeral
 
+Resilience flags (gtopkssgd_tpu/resilience — turn detect-and-halt into
+detect-and-recover):
+
+    --inject SPEC                        deterministic step-keyed fault
+                                         injection (nan_grad@K,
+                                         slow_rank:R:DURs@A-B,
+                                         loader_raise@K, preempt@K,
+                                         corrupt_ckpt@latest)
+    --recover-policy POLICY              rule=action[:budget[:param]] maps
+                                         anomaly rules to skip / rollback /
+                                         degrade instead of exit 44
+    --preempt-save / --no-preempt-save   SIGTERM/SIGINT -> emergency
+                                         step-granular checkpoint -> exit
+                                         45; resume with --resume
+    --allow-ckpt-mismatch                restore past a config_hash/state-
+                                         digest integrity mismatch
+
+Exit code registry: 0 ok, 43 stall watchdog, 44 anomaly halt, 45
+preempted-after-save.
+
 Summarize or diff the resulting metrics.jsonl with
 ``python -m gtopkssgd_tpu.obs.report <out-dir> [<other-out-dir>]``.
 Multi-host runs shard metrics per rank (metrics.rank{r}.jsonl); merge
@@ -213,6 +233,34 @@ def build_argparser() -> argparse.ArgumentParser:
                         "(obs.exporter; curl localhost:PORT/metrics); "
                         "0 disables (default), -1 binds an ephemeral "
                         "port (logged at startup)")
+    p.add_argument("--inject", default=None, metavar="SPEC",
+                   help="step-keyed fault injection (resilience subsystem; "
+                        "grammar KIND[:ARG...]@STEP|A-B|latest, comma-"
+                        "separated): nan_grad@120 poisons the gradient at "
+                        "step 120; slow_rank:2:2.5s@50-60 sleeps 2.5s per "
+                        "step on rank 2; loader_raise@75 raises from the "
+                        "data loader; preempt@200 delivers SIGTERM; "
+                        "corrupt_ckpt@latest truncates the newest "
+                        "checkpoint before restore. Deterministic, so "
+                        "chaos runs reproduce in CI")
+    p.add_argument("--recover-policy", default=None, metavar="POLICY",
+                   help="map anomaly rules to recovery actions instead of "
+                        "exit 44 (grammar rule=action[:budget[:param]], "
+                        "comma-separated; actions: skip, rollback, "
+                        "degrade) — e.g. 'nan_loss=skip,"
+                        "density_collapse=degrade:2:100'. Requires "
+                        "--obs-events; unmapped rules keep halt semantics")
+    p.add_argument("--allow-ckpt-mismatch", action="store_true",
+                   help="restore a checkpoint whose recorded config_hash/"
+                        "state digest disagrees with this run's (normally "
+                        "refused: resuming under different flags silently "
+                        "changes the experiment)")
+    p.add_argument("--preempt-save", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="intercept SIGTERM/SIGINT: forced step-granular "
+                        "emergency checkpoint, then exit 45 (resume with "
+                        "--resume); --no-preempt-save keeps the default "
+                        "signal disposition")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint from out-dir")
     p.add_argument("--multihost", action="store_true",
@@ -263,6 +311,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         obs_halt_on=args.obs_halt_on,
         obs_timeline=args.obs_timeline,
         obs_export_port=args.obs_export_port,
+        inject=args.inject,
+        recover_policy=args.recover_policy,
+        allow_ckpt_mismatch=args.allow_ckpt_mismatch,
         prefetch=args.prefetch,
         decode_workers=args.decode_workers,
     )
@@ -273,11 +324,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     enable_compilation_cache()
     args = build_argparser().parse_args(argv)
+    from gtopkssgd_tpu.resilience import (
+        PREEMPT_EXIT_CODE,
+        Preempted,
+        PreemptionGuard,
+        describe_policy,
+        retry_call,
+    )
+
     if args.multihost:
         # Multi-host pod slice / multislice: one process per host, same SPMD
         # program; ICI inside a slice, DCN across slices — both are just the
         # 'dp' axis to the program (reference: MPI.COMM_WORLD over ethernet).
-        jax.distributed.initialize()
+        # Coordinator rendezvous races at pod startup (hosts come up in
+        # arbitrary order) — the shared retry helper absorbs them.
+        retry_call(jax.distributed.initialize, retries=3, delay=2.0,
+                   desc="jax.distributed.initialize")
         # Announce this process's fleet identity up front — the same
         # process_index/count/coordinator triple lands in each shard's
         # run manifest (obs/manifest.py), which is how the fleet merger
@@ -286,17 +348,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         print(f"[dist] process {jax.process_index()}/"
               f"{jax.process_count()} coordinator="
-              f"{coordinator_address()}", flush=True)
+              f"{coordinator_address()} recovery="
+              f"{describe_policy(args.recover_policy)}", flush=True)
+    else:
+        # The resolved policy is part of the run's identity — print it
+        # where the operator (and the log scraper) will find it.
+        print(f"[dist] recovery policy: "
+              f"{describe_policy(args.recover_policy)}", flush=True)
     from gtopkssgd_tpu.obs.events import HALT_EXIT_CODE, AnomalyHalt
 
     with Trainer(config_from_args(args)) as trainer:
+        guard = None
+        if args.preempt_save:
+            guard = PreemptionGuard(logger=trainer.logger).install()
+            trainer.preempt = guard
         try:
-            return _run(args, trainer)
+            rc = _run(args, trainer)
+            trainer.finalize_resilience("completed")
+            return rc
         except AnomalyHalt as halt:
             # The monitor flushed the event record before raising; this
             # path only reports and maps to the contract exit code.
             trainer.logger.error("anomaly halt: %s", halt)
+            trainer.finalize_resilience("halted")
             return HALT_EXIT_CODE
+        except Preempted as why:
+            # Emergency checkpoint already durable (_preempt_now saved
+            # before raising); the exit code tells the harness to
+            # relaunch with --resume.
+            trainer.logger.warning("preempted: %s", why)
+            trainer.finalize_resilience("preempted")
+            return PREEMPT_EXIT_CODE
+        finally:
+            if guard is not None:
+                guard.close()
 
 
 def _run(args: argparse.Namespace, trainer: Trainer) -> int:

@@ -125,6 +125,11 @@ class AnomalyMonitor:
         self.timeline = timeline
         self.rho = rho
         self.halt_on = halt_on
+        # Recovery claim hook (resilience/policy.py): called with each
+        # fired event; returning True means a recovery action will
+        # handle it, which suppresses the halt for that event (the
+        # record still lands, tagged claimed=True). None = detect-only.
+        self.recovery = None
         self.th = thresholds or Thresholds()
         self.events: List[Dict[str, Any]] = []
         # EWMA state (loss mean/var, residual norm) + sample counts.
@@ -244,12 +249,20 @@ class AnomalyMonitor:
         halt severity. Shared by observe and observe_ranks."""
         halting = None
         for ev in fired:
+            # Offer the event to the recovery layer BEFORE the halt
+            # decision: a claimed event is about to be recovered from,
+            # so halting on it would defeat the policy. The claim is
+            # recorded on the event itself (only when a recovery layer
+            # exists — detect-only runs keep byte-identical records).
+            if self.recovery is not None:
+                ev["claimed"] = bool(self.recovery(ev))
             self.events.append(ev)
             if self.metrics is not None:
                 self.metrics.log("event", flush=True, **ev)
             if self.timeline is not None:
                 self.timeline.instant(f"event:{ev['rule']}", args=ev)
             if (self.halt_on is not None and halting is None
+                    and not ev.get("claimed")
                     and _SEVERITY_RANK[ev["severity"]]
                     >= _SEVERITY_RANK[self.halt_on]):
                 halting = ev

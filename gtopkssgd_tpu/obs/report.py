@@ -8,6 +8,8 @@ or gate one against a committed baseline.
     python -m gtopkssgd_tpu.obs.report attr <run|trace> # T_compute/T_select/
                                                         # T_comm decomposition
     python -m gtopkssgd_tpu.obs.report events <run>     # anomaly events by rule
+    python -m gtopkssgd_tpu.obs.report recovery <run>   # injected faults +
+                                                        # recovery actions
     python -m gtopkssgd_tpu.obs.report timeline <run>   # rebuild timeline.json
     python -m gtopkssgd_tpu.obs.report fleet <run>...   # cross-rank merge +
                                                         # straggler attribution
@@ -515,6 +517,121 @@ def run_events(run: str, json_out: Optional[str] = None) -> int:
     return 0
 
 
+def summarize_recovery(records: Iterable[dict]) -> dict:
+    """Resilience view over one run's records: injected faults (kind
+    "inject"), recovery actions (kind "recovery"), claimed vs unclaimed
+    anomaly events, and the end-of-run summary record's verdict."""
+    out = {
+        "injected": {},        # fault kind -> {count, first_step, last_step}
+        "actions": {},         # action -> {count, rules, first_step, last_step}
+        "events_claimed": 0,
+        "events_unclaimed": 0,
+        "final_status": None,
+        "n_recoveries": None,
+        "final_step": None,
+    }
+    for rec in records:
+        kind = rec.get("kind")
+        step = rec.get("step")
+        if kind == "inject":
+            f = out["injected"].setdefault(str(rec.get("fault", "?")), {
+                "count": 0, "first_step": None, "last_step": None})
+            f["count"] += 1
+            if isinstance(step, (int, float)):
+                f["first_step"] = (step if f["first_step"] is None
+                                   else min(f["first_step"], step))
+                f["last_step"] = (step if f["last_step"] is None
+                                  else max(f["last_step"], step))
+        elif kind == "recovery":
+            action = str(rec.get("action", "?"))
+            if action == "summary":
+                out["final_status"] = rec.get("final_status")
+                out["n_recoveries"] = rec.get("n_recoveries")
+                out["final_step"] = step
+                continue
+            a = out["actions"].setdefault(action, {
+                "count": 0, "rules": {}, "first_step": None,
+                "last_step": None})
+            a["count"] += 1
+            rule = rec.get("rule")
+            if rule is not None:
+                a["rules"][str(rule)] = a["rules"].get(str(rule), 0) + 1
+            if isinstance(step, (int, float)):
+                a["first_step"] = (step if a["first_step"] is None
+                                   else min(a["first_step"], step))
+                a["last_step"] = (step if a["last_step"] is None
+                                  else max(a["last_step"], step))
+        elif kind == "event":
+            if rec.get("claimed"):
+                out["events_claimed"] += 1
+            else:
+                out["events_unclaimed"] += 1
+    return out
+
+
+def format_recovery(name: str, summary: dict) -> str:
+    chunks = [f"recovery: {name}"]
+    injected = summary["injected"]
+    if injected:
+        rows = [[fault, str(f["count"]),
+                 "-" if f["first_step"] is None else _fmt(f["first_step"]),
+                 "-" if f["last_step"] is None else _fmt(f["last_step"])]
+                for fault, f in sorted(injected.items())]
+        chunks.append(f"\n[inject] ({sum(f['count'] for f in injected.values())} firings)")
+        chunks.append(_table(rows, ["fault", "count", "first_step",
+                                    "last_step"]))
+    actions = summary["actions"]
+    if actions:
+        rows = []
+        for action, a in sorted(actions.items()):
+            rules = "  ".join(f"{rule}={n}"
+                              for rule, n in sorted(a["rules"].items()))
+            rows.append([
+                action, str(a["count"]),
+                "-" if a["first_step"] is None else _fmt(a["first_step"]),
+                "-" if a["last_step"] is None else _fmt(a["last_step"]),
+                rules or "-"])
+        chunks.append(f"\n[recovery] ({sum(a['count'] for a in actions.values())} actions)")
+        chunks.append(_table(rows, ["action", "count", "first_step",
+                                    "last_step", "rules"]))
+    if not injected and not actions:
+        chunks.append("no injected faults or recovery actions recorded")
+    claimed, unclaimed = (summary["events_claimed"],
+                          summary["events_unclaimed"])
+    if claimed or unclaimed:
+        chunks.append(f"\nanomaly events: {claimed} claimed by recovery, "
+                      f"{unclaimed} unclaimed")
+    if summary["final_status"] is not None:
+        chunks.append(
+            f"final: status={summary['final_status']} "
+            f"n_recoveries={summary['n_recoveries']} "
+            + ("" if summary["final_step"] is None
+               else f"step={_fmt(summary['final_step'])}"))
+    return "\n".join(chunks)
+
+
+def run_recovery(run: str, json_out: Optional[str] = None) -> int:
+    """``recovery`` subcommand: the resilience story of one run —
+    injected faults, recovery actions by kind, claimed/unclaimed events,
+    and the end-of-run verdict."""
+    try:
+        records, bad = load_records(run)
+    except OSError as e:
+        print(f"cannot read {run}: {e}")
+        return 2
+    if bad:
+        print(f"note: {run}: skipped {bad} malformed line(s)")
+    summary = summarize_recovery(records)
+    name = os.path.basename(os.path.normpath(run)) or run
+    print(format_recovery(name, summary))
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump(summary, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0
+
+
 def run_timeline(run: str, out: Optional[str] = None) -> int:
     """``timeline`` subcommand: rebuild a chrome-trace timeline from a
     run's metrics.jsonl (markers + counter tracks at recorded wall-clock
@@ -827,6 +944,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.add_argument("--json", dest="json_out", default=None)
         a = ap.parse_args(argv[1:])
         return run_events(a.run, json_out=a.json_out)
+    if argv and argv[0] == "recovery":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report recovery",
+            description="Summarize a run's resilience records: injected "
+                        "faults, recovery actions, claimed vs unclaimed "
+                        "anomaly events, final status.")
+        ap.add_argument("run")
+        ap.add_argument("--json", dest="json_out", default=None)
+        a = ap.parse_args(argv[1:])
+        return run_recovery(a.run, json_out=a.json_out)
     if argv and argv[0] == "timeline":
         ap = argparse.ArgumentParser(
             "gtopkssgd_tpu.obs.report timeline",

@@ -1,0 +1,70 @@
+"""Resilience subsystem: detect-and-recover for long synchronous runs.
+
+The paper's regime (arXiv:1901.04059) is many-hour synchronous training
+across many low-bandwidth workers — exactly where NaN steps, stragglers,
+preemptions, and corrupt checkpoints kill runs. PRs 1-4 built detection
+(stall watchdog exit 43, AnomalyMonitor halt exit 44, fleet straggler
+analytics) that can only STOP a run; this package makes the run survive,
+with a deterministic fault-injection layer so every recovery path is
+provable in CI on a CPU mesh:
+
+  inject.py  — step-keyed ``--inject SPEC`` fault injection
+      (``nan_grad@120``, ``slow_rank:2:2.5s@50-60``, ``preempt@200``,
+      ``loader_raise@75``, ``corrupt_ckpt@latest``): perturbs gradients,
+      timing, signals, and checkpoint bytes deterministically, logging
+      each firing as an "inject" record.
+  policy.py  — ``--recover-policy`` maps AnomalyMonitor rules to
+      recovery actions instead of exit 44: *skip* (discard the update,
+      keep the pre-step state — residual included — under a
+      consecutive-skip budget), *rollback* (restore the last good
+      checkpoint with per-rule retry budgets and exponential backoff),
+      *degrade* (fall back from sparse to dense allreduce, re-entering
+      sparse after a cooldown). Every action is a registered "recovery"
+      record.
+  preempt.py — SIGTERM/SIGINT preemption guard (flag-setting handlers;
+      the trainer turns the flag into a forced step-granular emergency
+      save then ``Preempted`` -> exit 45; 43=stall and 44=halt stay
+      reserved) plus the shared ``retry_call`` backoff helper used for
+      ``jax.distributed.initialize`` and data-loader setup.
+
+Checkpoint integrity (config-hash + treedef-digest sidecars, verified on
+restore with fallback to the previous step) lives with the checkpoint
+code in utils/checkpoint.py; error-feedback correctness under recovery
+(arXiv:1911.08772 ties convergence to the residual dynamics, so a
+recovery that drops or duplicates residual state is silently wrong) is
+what the skip/rollback semantics here are designed around.
+"""
+
+from gtopkssgd_tpu.resilience.inject import (
+    Fault,
+    FaultInjector,
+    InjectedLoaderError,
+    parse_inject,
+)
+from gtopkssgd_tpu.resilience.policy import (
+    ActionSpec,
+    RecoveryManager,
+    describe_policy,
+    parse_policy,
+)
+from gtopkssgd_tpu.resilience.preempt import (
+    PREEMPT_EXIT_CODE,
+    Preempted,
+    PreemptionGuard,
+    retry_call,
+)
+
+__all__ = [
+    "PREEMPT_EXIT_CODE",
+    "ActionSpec",
+    "Fault",
+    "FaultInjector",
+    "InjectedLoaderError",
+    "Preempted",
+    "PreemptionGuard",
+    "RecoveryManager",
+    "describe_policy",
+    "parse_inject",
+    "parse_policy",
+    "retry_call",
+]

@@ -1,0 +1,118 @@
+"""Preemption handling + the shared retry/backoff helper.
+
+Cloud TPU/GPU capacity is preemptible: the scheduler sends SIGTERM and
+gives the process a grace window. The reference's answer was losing the
+run (and, on resume from an epoch checkpoint, silently dropping the
+error-feedback residuals — SURVEY.md §5). Here the contract is:
+
+  signal -> PreemptionGuard handler sets a flag (handlers must be
+  async-signal-safe: no I/O, no device sync) -> the train loop checks
+  the flag at its next iteration boundary -> forced step-granular
+  emergency checkpoint (orbax, force=True) -> ``Preempted`` ->
+  dist_trainer exits PREEMPT_EXIT_CODE (45; 43=stall and 44=anomaly
+  halt stay reserved). ``--resume`` then restores the emergency step
+  and fast-forwards the data stream mid-epoch, so the resumed loss
+  trace is the uninterrupted one.
+
+``retry_call`` is the shared transient-failure helper (exponential
+backoff, bounded attempts) wrapped around ``jax.distributed.initialize``
+(coordinator races at pod startup) and data-loader setup/fetch (NFS
+blips; also how injected loader_raise faults are absorbed).
+"""
+
+from __future__ import annotations
+
+import signal
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+# Exit code for a preemption-triggered shutdown after the emergency
+# save. The registry: 43 = stall watchdog, 44 = anomaly halt, 45 = this.
+PREEMPT_EXIT_CODE = 45
+
+
+class Preempted(RuntimeError):
+    """Raised by the trainer once the emergency checkpoint is durable;
+    dist_trainer maps it to PREEMPT_EXIT_CODE."""
+
+
+class PreemptionGuard:
+    """Flag-setting SIGTERM/SIGINT handlers with restore-on-close.
+
+    Installed by dist_trainer (NOT by Trainer.__init__ — a library
+    object must not silently steal the host process's signal disposition;
+    tests and notebooks embedding a Trainer keep their handlers). The
+    handler only sets a flag: everything stateful (the device sync, the
+    orbax write) happens on the train loop thread at the next iteration
+    boundary, step-granular by construction."""
+
+    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                   signal.SIGINT),
+                 logger=None):
+        self.signals = signals
+        self.logger = logger
+        self.triggered = False
+        self.signum: Optional[int] = None
+        self._old: dict = {}
+        self._installed = False
+
+    def _handler(self, signum, frame):
+        self.triggered = True
+        self.signum = signum
+
+    def install(self) -> "PreemptionGuard":
+        """Idempotent; a non-main thread (signal.signal raises there)
+        degrades to an inert guard rather than failing the run."""
+        if self._installed:
+            return self
+        try:
+            for sig in self.signals:
+                self._old[sig] = signal.signal(sig, self._handler)
+            self._installed = True
+        except ValueError:
+            if self.logger is not None:
+                self.logger.warning(
+                    "preemption guard: not on the main thread; signals "
+                    "not intercepted")
+        return self
+
+    def close(self) -> None:
+        """Restore the original handlers (pytest's own SIGINT handling,
+        a parent harness's SIGTERM trap)."""
+        for sig, old in self._old.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old.clear()
+        self._installed = False
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def retry_call(fn: Callable[[], Any], *, retries: int = 3,
+               delay: float = 0.5, backoff: float = 2.0,
+               exceptions: Tuple[Type[BaseException], ...] = (Exception,),
+               logger=None, desc: str = "call") -> Any:
+    """Call ``fn`` with up to ``retries`` retries on ``exceptions``,
+    sleeping delay * backoff**attempt between tries. The final failure
+    re-raises the original exception — callers see the true error, with
+    the retry history in the log."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except exceptions as e:
+            if attempt >= retries:
+                raise
+            wait = delay * (backoff ** attempt)
+            attempt += 1
+            if logger is not None:
+                logger.warning(
+                    "%s failed (%s: %s); retry %d/%d in %.2gs",
+                    desc, type(e).__name__, e, attempt, retries, wait)
+            time.sleep(wait)

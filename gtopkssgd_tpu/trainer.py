@@ -54,7 +54,7 @@ from gtopkssgd_tpu.obs import (
     layer_names,
     telemetry_scalars,
 )
-from gtopkssgd_tpu.obs.manifest import run_manifest
+from gtopkssgd_tpu.obs.manifest import config_hash, run_manifest
 from gtopkssgd_tpu.obs.watchdog import _default_on_stall
 from gtopkssgd_tpu.parallel import make_mesh
 from gtopkssgd_tpu.utils import (
@@ -184,6 +184,26 @@ class TrainConfig:
                                    # ephemeral port (tests); 0 disables.
                                    # Every process exports — scrape each
                                    # host for its own rank's view
+    inject: Optional[str] = None   # step-keyed fault injection spec
+                                   # (resilience/inject.py grammar:
+                                   # KIND[:ARG...]@STEP|A-B|latest,
+                                   # comma-separated — e.g.
+                                   # "nan_grad@120,preempt@200");
+                                   # deterministic, so chaos runs
+                                   # reproduce in CI. None disables
+    recover_policy: Optional[str] = None  # map anomaly rules to
+                                   # recovery actions instead of exit
+                                   # 44 (resilience/policy.py grammar:
+                                   # rule=action[:budget[:param]] —
+                                   # e.g. "nan_loss=skip,
+                                   # density_collapse=degrade:2:100").
+                                   # Requires obs_events. None = halt
+                                   # semantics unchanged
+    allow_ckpt_mismatch: bool = False  # restore a checkpoint whose
+                                   # recorded config_hash/state digest
+                                   # disagrees with this run's (the
+                                   # explicit escape hatch; normally a
+                                   # mismatched resume is refused)
     prefetch: int = 2              # host batches assembled ahead by a
                                    # background thread (0 = synchronous;
                                    # reference C8 parity with DataLoader
@@ -337,6 +357,36 @@ class Trainer:
                           diagnostics=self._stall_diagnostics)
             if cfg.obs_watchdog > 0 else None
         )
+        # Resilience layer (gtopkssgd_tpu/resilience): deterministic
+        # step-keyed fault injection, and the recovery manager that
+        # claims monitor events before they escalate to a halt. The
+        # preemption guard is NOT installed here — a library object
+        # must not steal the host process's signal handlers; dist_trainer
+        # (or a test) installs one and assigns it to `self.preempt`.
+        from gtopkssgd_tpu.resilience import (
+            FaultInjector,
+            RecoveryManager,
+            parse_policy,
+            retry_call,
+        )
+
+        self.injector = (
+            FaultInjector(cfg.inject, metrics=self.metrics,
+                          logger=self.logger, rank=self.process_rank)
+            if cfg.inject else None
+        )
+        self.recovery = (
+            RecoveryManager(parse_policy(cfg.recover_policy),
+                            metrics=self.metrics, logger=self.logger)
+            if cfg.recover_policy else None
+        )
+        if self.recovery is not None:
+            if self.monitor is None:
+                raise ValueError(
+                    "recover_policy requires obs_events (recovery acts "
+                    "on AnomalyMonitor events)")
+            self.monitor.recovery = self.recovery.claim
+        self.preempt = None
 
         self.model, self.spec = get_model(
             cfg.dnn,
@@ -360,34 +410,26 @@ class Trainer:
             data_kw["decode_workers"] = cfg.decode_workers
         if cfg.dataset == "cifar10" and cfg.synth_hard:
             data_kw["synth_hard"] = True
+        def _dataset(**kw):
+            # Data-loader setup rides the shared retry/backoff helper
+            # (resilience/preempt.py): a transient storage blip at
+            # startup must not kill a pod-sized run before step 1.
+            return retry_call(
+                functools.partial(get_dataset, cfg.dataset, **kw),
+                retries=2, delay=0.5, logger=self.logger,
+                desc=f"get_dataset({cfg.dataset})")
+
         self.train_shards = [
-            get_dataset(cfg.dataset, split="train", rank=r,
-                        nworkers=cfg.nworkers, **data_kw)
+            _dataset(split="train", rank=r, nworkers=cfg.nworkers,
+                     **data_kw)
             for r in self.local_ranks
         ]
-        self.val_data = get_dataset(cfg.dataset, split="test", **data_kw)
+        self.val_data = _dataset(split="test", **data_kw)
         self.steps_per_epoch = shard_steps_per_epoch(
             self.train_shards[0], cfg.batch_size, cfg.nsteps_update
         )
 
-        self.tx = gtopk_sgd(
-            self._lr_schedule(),
-            momentum=cfg.momentum,
-            weight_decay=cfg.weight_decay,
-            nesterov=cfg.nesterov,
-            compression=cfg.compression,
-            density=cfg.density,
-            topk_method=cfg.topk_method,
-            clip_grad_norm=cfg.clip_grad_norm,
-            axis_name="dp" if self.p > 1 else None,
-            hier_ici_size=cfg.hier_ici,
-            warmup_dense_steps=cfg.dense_warmup_epochs * self.steps_per_epoch,
-            momentum_correction=cfg.momentum_correction,
-            _restore_rejected_u=cfg.restore_rejected_u,
-            telemetry=cfg.obs_counters,
-            telemetry_layers=cfg.obs_layers,
-            telemetry_audit_interval=cfg.obs_audit_interval,
-        )
+        self.tx = self._make_tx()
         self.state, self.carry = self._init_state()
         # Layer-name column for "layers" records: index i of every
         # telemetry [L] array is leaf i of the params pytree in jax.tree
@@ -404,17 +446,72 @@ class Trainer:
             steps_per_epoch=self.steps_per_epoch))
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
+        # Degrade fallback (recover-policy "degrade"): the sparse step
+        # stays canonical; a dense-allreduce variant over the SAME
+        # optimizer state treedef (warmup_dense_steps=2**30 selects the
+        # dense branch of the compiled update) is built lazily on the
+        # first degrade action.
+        self._sparse_step = self._train_step
+        self._dense_step = None
+        self._degraded = False
+        self._degrade_until = 0
         # Checkpoints: orbax save/restore of the live sharded state; on
         # multi-host EVERY process participates (orbax coordinates; each
         # writes its addressable residual shards) over a shared filesystem.
+        # The manager stamps each save with a config_hash so a mismatched
+        # resume is refused instead of silently changing the experiment —
+        # computed with the resilience knobs nulled out: an injected-fault
+        # run and its clean resume are the SAME experiment (the injection
+        # perturbs execution, never the checkpointable state treedef), and
+        # a chaos run that could not be resumed without --inject would
+        # defeat the preempt/resume path it exists to test.
+        ckpt_hash = config_hash(dataclasses.replace(
+            cfg, inject=None, recover_policy=None,
+            allow_ckpt_mismatch=False))
         self._ckpt = (
-            CheckpointManager(f"{cfg.out_dir}/ckpt") if cfg.out_dir else None
+            CheckpointManager(f"{cfg.out_dir}/ckpt",
+                              config_hash=ckpt_hash,
+                              logger=self.logger)
+            if cfg.out_dir else None
         )
         self._set_iters(start_epoch=0)
 
-    def _set_iters(self, start_epoch: int) -> None:
+    def _make_tx(self, warmup_dense_steps: Optional[int] = None):
+        """The optimizer transform; ``warmup_dense_steps`` overrides the
+        config-derived value (the degrade fallback passes 2**30 to pin
+        the always-dense branch — identical state treedef, so the live
+        state flows between the sparse and degraded steps unchanged)."""
+        cfg = self.cfg
+        if warmup_dense_steps is None:
+            warmup_dense_steps = (
+                cfg.dense_warmup_epochs * self.steps_per_epoch)
+        return gtopk_sgd(
+            self._lr_schedule(),
+            momentum=cfg.momentum,
+            weight_decay=cfg.weight_decay,
+            nesterov=cfg.nesterov,
+            compression=cfg.compression,
+            density=cfg.density,
+            topk_method=cfg.topk_method,
+            clip_grad_norm=cfg.clip_grad_norm,
+            axis_name="dp" if self.p > 1 else None,
+            hier_ici_size=cfg.hier_ici,
+            warmup_dense_steps=warmup_dense_steps,
+            momentum_correction=cfg.momentum_correction,
+            _restore_rejected_u=cfg.restore_rejected_u,
+            telemetry=cfg.obs_counters,
+            telemetry_layers=cfg.obs_layers,
+            telemetry_audit_interval=cfg.obs_audit_interval,
+        )
+
+    def _set_iters(self, start_epoch: int, skip_steps: int = 0) -> None:
         """(Re)create the persistent per-shard iterators from a given epoch
-        permutation — used at init and to fast-forward after restore."""
+        permutation — used at init and to fast-forward after restore.
+        ``skip_steps`` drains that many optimizer steps' worth of batches
+        from each shard on top of the epoch seek: emergency preemption
+        checkpoints land MID-epoch, and a bit-exact resumed loss trace
+        needs the data stream aligned to the restored step, not the
+        enclosing epoch boundary."""
 
         def gen(ds, start):
             e = start
@@ -427,6 +524,9 @@ class Trainer:
         # stream would be discarded by close()'s drain — a silent skip).
         self.close()
         iters = [gen(s, start_epoch) for s in self.train_shards]
+        for it in iters:
+            for _ in range(skip_steps * self.cfg.nsteps_update):
+                next(it)
         self._iters = iters
         # (Re)start the background prefetcher on the fresh iterators. The
         # closure binds the local `iters` list, not self._iters, so even a
@@ -673,8 +773,14 @@ class Trainer:
         return loss, (new_bs, carry, {"top1": top1, "top5": top5})
 
     # ------------------------------------------------------------ the step
-    def _build_train_step(self):
+    def _build_train_step(self, tx=None):
         cfg, p = self.cfg, self.p
+        tx = self.tx if tx is None else tx
+        # Recovery holds the pre-step state snapshot across the dispatch
+        # (skip restores it bit-identically), so buffer donation is off
+        # when a recovery policy is active. safe_donate already returns
+        # () on CPU, where every recovery test runs.
+        donate = safe_donate(0, 1) if self.recovery is None else ()
 
         def step(state: TrainState, carry, batch):
             # batch leaves: [nsteps_update, B, ...]; carry: per-device pytree.
@@ -701,7 +807,7 @@ class Trainer:
                 (batch, jnp.arange(cfg.nsteps_update)),
             )
             grads = jax.tree.map(lambda g: g / cfg.nsteps_update, grads)
-            updates, opt_state = self.tx.update(
+            updates, opt_state = tx.update(
                 grads, state.opt_state, state.params
             )
             params = optax.apply_updates(state.params, updates)
@@ -768,7 +874,7 @@ class Trainer:
             return s, c2, loss, aux
 
         if p == 1:
-            return jax.jit(shardwise, donate_argnums=safe_donate(0, 1))
+            return jax.jit(shardwise, donate_argnums=donate)
 
         # Per-leaf specs: everything in the state is replicated EXCEPT the
         # error-feedback residual, which is per-device ([P, N], sharded over
@@ -794,7 +900,7 @@ class Trainer:
             out_specs=(state_spec, P("dp"), P(), P()),
             check_vma=False,
         )
-        return jax.jit(smapped, donate_argnums=safe_donate(0, 1))
+        return jax.jit(smapped, donate_argnums=donate)
 
     def _build_eval_step(self):
         """Eval step; sharded over the mesh when p > 1 (VERDICT round-2
@@ -877,11 +983,29 @@ class Trainer:
             for k, v in np_batch.items()
         }
 
+    def _fetch_host(self, step: int, spd: int) -> Dict[str, np.ndarray]:
+        """One host batch from the prefetcher (or synchronously). With an
+        injector active, loader faults (injected or real) are absorbed by
+        the shared retry helper — a transient IOError costs a retry, not
+        the run."""
+        def fetch():
+            if self.injector is not None:
+                self.injector.check_loader(step, step + spd)
+            return (next(self._prefetch) if self._prefetch is not None
+                    else self._stack_shard_batches(self._iters))
+
+        if self.injector is None:
+            return fetch()
+        from gtopkssgd_tpu.resilience import retry_call
+
+        return retry_call(fetch, retries=2, delay=0.05,
+                          logger=self.logger, desc="host batch fetch")
+
     # -------------------------------------------------------------- train
     def train(self, num_iters: int, epoch: int = 0) -> Dict[str, float]:
         """Run `num_iters` optimizer steps (reference DLTrainer.train)."""
-        iters = self._iters
         cfg = self.cfg
+        inj, rec, guard = self.injector, self.recovery, self.preempt
         t_start, samples = time.perf_counter(), 0
         last_loss, last_aux = float("nan"), {}
         if num_iters <= 0:
@@ -911,12 +1035,24 @@ class Trainer:
             wd.arm("train", step=step)
         try:
             for _ in range(num_iters // spd if spd > 1 else num_iters):
+                # Preemption flag check at the iteration boundary: the
+                # signal handler (resilience/preempt.py) only sets the
+                # flag; the emergency save + unwind happen HERE, where
+                # the state is whole.
+                if guard is not None and guard.triggered:
+                    self._preempt_now()
+                # Degrade cooldown expiry: re-enter the sparse step.
+                if self._degraded and step >= self._degrade_until:
+                    self._train_step = self._sparse_step
+                    self._degraded = False
+                    if rec is not None:
+                        rec.degraded = False
+                        rec.record("sparse_resume", step=step)
+                if inj is not None:
+                    inj.sleep_if_slow(step, step + spd)
                 with self.tracer.span("io"):
-                    hosts = [
-                        (next(self._prefetch) if self._prefetch is not None
-                         else self._stack_shard_batches(iters))
-                        for _ in range(spd)
-                    ]
+                    hosts = [self._fetch_host(step, spd)
+                             for _ in range(spd)]
                     if spd == 1:
                         host = hosts[0]
                     else:
@@ -928,6 +1064,14 @@ class Trainer:
                             for k in hosts[0]
                         }
                     batch = self._device_batch(host)
+                if rec is not None:
+                    # Pre-step snapshot: what a `skip` action restores.
+                    # Valid across the dispatch because donation is
+                    # disabled whenever recovery is active.
+                    prev_state, prev_carry = self.state, self.carry
+                if inj is not None:
+                    self.state = inj.poison_params(
+                        self.state, step, step + spd)
                 with self.tracer.span("dispatch"):
                     # Async enqueue only — the span must NOT drain the
                     # queue (the overlap is the point); device time shows
@@ -938,6 +1082,13 @@ class Trainer:
                 samples += (cfg.batch_size * cfg.nworkers
                             * cfg.nsteps_update * spd)
                 step += spd
+                if inj is not None:
+                    # preempt injection delivers a real SIGTERM through
+                    # the installed guard; the flag check right after
+                    # makes the firing step-deterministic.
+                    inj.maybe_preempt(step - spd, step, guard)
+                if guard is not None and guard.triggered:
+                    self._preempt_now()
                 synced = False
                 # On-device counters (obs.counters, carried in
                 # opt_state.telemetry). float() blocks until the
@@ -986,22 +1137,35 @@ class Trainer:
                     last_loss = float(loss)
                     last_aux = {k: float(v) for k, v in aux.items()}
                     elapsed = time.perf_counter() - t_start
-                    rec = dict(
+                    row = dict(
                         step=step, epoch=epoch, loss=last_loss,
                         throughput=samples / elapsed, **last_aux,
                     )
                     if cfg.dataset == "ptb":
-                        rec["ppl"] = float(np.exp(min(last_loss, 20.0)))
-                    self.metrics.log("train", **rec)
+                        row["ppl"] = float(np.exp(min(last_loss, 20.0)))
+                    self.metrics.log("train", **row)
                     self.tracer.flush(step)
                     if self.timeline is not None:
-                        self.timeline.counter("train", rec)
+                        self.timeline.counter("train", row)
                     # Monitor at the log cadence too, so NaN detection
                     # works with obs counters disabled (loss only — the
                     # float() above already paid the sync).
                     if self.monitor is not None and not observed:
                         self.monitor.observe(step, loss=last_loss)
+                        observed = True
                     synced = True
+                if rec is not None:
+                    # Apply any actions the monitor's claims queued this
+                    # iteration. `step` may rewind (skip/rollback restore
+                    # an earlier state) — the host mirror follows the
+                    # restored state.step so the data stream and LR
+                    # schedule stay aligned.
+                    pending = rec.pop_pending()
+                    if pending:
+                        step = self._apply_recovery(
+                            pending, prev_state, prev_carry, step)
+                    elif observed:
+                        rec.note_ok()
                 if wd is not None and synced:
                     wd.heartbeat(step=step)
             # true_sync, not block_until_ready: the tunneled TPU platform
@@ -1196,16 +1360,119 @@ class Trainer:
     def restore(self) -> bool:
         if self._ckpt is None or self._ckpt.latest_step() is None:
             return False
+        if self.injector is not None:
+            # corrupt_ckpt@latest fires here, right before the read — the
+            # restore path's torn-checkpoint fallback is what's under test.
+            self.injector.maybe_corrupt_ckpt(self._ckpt.directory)
         # Abstract template with explicit shardings: orbax restores every
         # leaf directly INTO its target placement — replicated over the
         # mesh for params/step/momentum, P('dp') for the per-device
         # residual (no dense single-device materialization, and every
         # process of a multi-host run reads only its own residual shards).
-        self.state = self._ckpt.restore(self._state_template())
-        # Fast-forward the data stream to the restored epoch's permutation
-        # (epoch-level granularity: checkpoints are written at epoch ends).
-        self._set_iters(int(self.state.step) // self.steps_per_epoch)
+        self.state = self._ckpt.restore(
+            self._state_template(),
+            allow_mismatch=self.cfg.allow_ckpt_mismatch)
+        step = int(self.state.step)
+        self.logger.info("restored step %d from %s", step,
+                         self._ckpt.directory)
+        # Fast-forward the data stream to the restored position. Epoch
+        # checkpoints land on a boundary (skip_steps=0); emergency
+        # preemption saves land MID-epoch, and the remainder drains that
+        # many steps' batches so the resumed trace is the uninterrupted
+        # one.
+        self._set_iters(step // self.steps_per_epoch,
+                        skip_steps=step % self.steps_per_epoch)
         return True
+
+    # ---------------------------------------------------------- resilience
+    def _preempt_now(self) -> None:
+        """The preemption flag is set: force a step-granular emergency
+        save (orbax force=True — the step may equal an existing epoch
+        save) and unwind via Preempted, which dist_trainer maps to exit
+        45. Runs on the train-loop thread where the state is whole."""
+        from gtopkssgd_tpu.resilience import Preempted
+
+        step = int(self.state.step)  # blocks: the save must be post-step
+        if self._ckpt is not None:
+            self._ckpt.save(step, self.state, force=True)
+            self.metrics.log("recovery", flush=True,
+                             action="emergency_save", step=step)
+            self.logger.warning(
+                "preemption: emergency checkpoint at step %d -> %s",
+                step, self._ckpt.directory)
+        else:
+            self.logger.warning(
+                "preemption at step %d with no out_dir: nothing saved",
+                step)
+        raise Preempted(f"preemption signal at step {step}")
+
+    def _apply_recovery(self, pending, prev_state, prev_carry,
+                        step: int) -> int:
+        """Apply the actions claimed during this iteration's monitor
+        observations. Returns the (possibly rewound) host step mirror."""
+        from gtopkssgd_tpu.obs.events import AnomalyHalt
+
+        rec = self.recovery
+        for event, spec in pending:
+            rule = spec.rule
+            if spec.action == "skip":
+                # Discard the just-applied update: restore the pre-step
+                # snapshot — params, momentum, step count, AND the
+                # error-feedback residual, bit-identical (donation is off
+                # under recovery, so the buffers are intact).
+                self.state, self.carry = prev_state, prev_carry
+                rec.consecutive_skips += 1
+                step = int(self.state.step)
+                rec.record("skip", step, rule,
+                           consecutive=rec.consecutive_skips,
+                           budget=spec.budget)
+            elif spec.action == "rollback":
+                if self._ckpt is None or self._ckpt.latest_step() is None:
+                    self.logger.error(
+                        "recovery: rollback for rule %s but no checkpoint "
+                        "exists — escalating to halt", rule)
+                    raise AnomalyHalt(event)
+                uses = rec.rollback_uses.get(rule, 0)
+                wait = spec.param * (2 ** uses)
+                rec.rollback_uses[rule] = uses + 1
+                if wait > 0:
+                    time.sleep(wait)
+                self.restore()
+                step = int(self.state.step)
+                rec.record("rollback", step, rule, backoff_s=wait,
+                           use=uses + 1, budget=spec.budget)
+            elif spec.action == "degrade":
+                if self._degraded:
+                    continue
+                if self._dense_step is None:
+                    # Dense-allreduce fallback over the SAME state
+                    # treedef: the always-dense branch of the compiled
+                    # update (warmup_dense_steps=2**30).
+                    self._dense_step = self._build_train_step(
+                        tx=self._make_tx(warmup_dense_steps=1 << 30))
+                self._train_step = self._dense_step
+                self._degraded = True
+                rec.degraded = True
+                rec.degrade_episodes += 1
+                self._degrade_until = step + int(spec.param)
+                rec.record("degrade", step, rule,
+                           until_step=self._degrade_until,
+                           episode=rec.degrade_episodes,
+                           budget=spec.budget)
+        return step
+
+    def finalize_resilience(self, status: str) -> None:
+        """End-of-run summary record — what ``report recovery`` and the
+        gate smoke's structural checks key on. No-op for runs with no
+        resilience surface (keeps default metrics files byte-stable)."""
+        if (self.injector is None and self.recovery is None
+                and status == "completed"):
+            return
+        n = self.recovery.n_recoveries if self.recovery is not None else 0
+        self.metrics.log(
+            "recovery", flush=True, action="summary", final_status=status,
+            completed=int(status == "completed"), n_recoveries=n,
+            step=int(self.state.step))
 
     def _state_template(self):
         from jax.sharding import NamedSharding

@@ -49,6 +49,9 @@ KINDS = frozenset({
     "attr_error",  # attribution capture failure (gate smoke)
     "fleet",       # cross-rank merged per-step stats (obs/fleet.py)
     "ledger",      # predicted-vs-measured comm model rows (obs/ledger.py)
+    "inject",      # injected-fault firings (resilience/inject.py)
+    "recovery",    # recovery actions + end-of-run summary
+                   # (resilience/policy.py, trainer emergency save)
 })
 
 _SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")
