@@ -82,7 +82,69 @@ def _fail_fast_if_backend_dead(timeout_s: float = 180.0) -> None:
           f"{timeout_s:.0f}s (dead device tunnel?); refusing to hang — "
           "fix the tunnel and re-run", file=sys.stderr)
     print(_latest_onchip_artifact_note(), file=sys.stderr)
-    raise SystemExit(3)
+    raise SystemExit(_cpu_fallback_bench())
+
+
+def _cpu_fallback_bench() -> int:
+    """Dead-tunnel fallback: emit a fresh CPU/interpret-mode selection
+    microbench line instead of only the backend-probe record (rc=3, no
+    parsed data — the BENCH_r02..r05 shape). Runs benchmarks/topk_bench.py
+    --cpu-fallback in a SUBPROCESS: this process's backend is poisoned (a
+    daemon thread is still blocked inside PJRT client init), and the
+    child must call force_cpu_mesh before its first backend touch. On
+    success prints ONE driver-format JSON line headlining twostage-vs-
+    exact selection recall at CIFAR scale (interpret-mode ms are not
+    device numbers; recall and the one-pass op-size evidence are the
+    comparable fields) and returns 0; if the fallback itself fails,
+    returns the legacy 3 so the rc still signals a dead round."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    art = os.path.join(here, "benchmarks", "results",
+                       "topk_bench_cpu_fallback.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "benchmarks.topk_bench",
+         "--cpu-fallback", "--out", art],
+        cwd=here, capture_output=True, text=True, timeout=1800)
+    if proc.returncode != 0:
+        print("bench.py: cpu-fallback microbench failed "
+              f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}",
+              file=sys.stderr)
+        return 3
+    try:
+        with open(art) as f:
+            result = json.load(f)
+        rows = {r["method"]: r for r in result["rows"]
+                if r.get("error") is None}
+        ts = rows["twostage"]
+        exact = rows["exact"]
+    except (OSError, KeyError, ValueError) as e:
+        print(f"bench.py: cpu-fallback artifact unreadable: {e}",
+              file=sys.stderr)
+        return 3
+    evidence = result.get("one_pass_evidence", {})
+    print(json.dumps({
+        "metric": (f"topk_twostage_recall_vs_exact_n{ts['n']}"
+                   f"_rho{ts['density']}_cpu_fallback"),
+        "value": ts["recall_vs_exact"],
+        "unit": "recall",
+        "vs_baseline": ts["recall_vs_exact"],  # exact recall == 1.0
+        "backend": "cpu_fallback",
+        "pallas_interpret": True,
+        "twostage_ms_interpret": ts["ms"],
+        "exact_ms_interpret": exact["ms"],
+        "tau_twostage_mask_recall": rows.get(
+            "tau_twostage", {}).get("recall_vs_exact"),
+        "count_single_pass": evidence.get("single_pass"),
+        "count_bucketize_passes_over_x": evidence.get(
+            "bucketize_passes_over_x"),
+        "count_vmap8_passes_over_x": evidence.get("vmap8_passes_over_x"),
+        "artifact": os.path.relpath(art, here),
+        "note": "dead tunnel: interpret-mode selection microbench; "
+                "ms columns are NOT device numbers",
+    }))
+    return 0
 
 
 def latest_bench_artifact_path():

@@ -88,6 +88,66 @@ def run_recovery_smoke(out_dir: str) -> str:
     return rec_dir
 
 
+def run_twostage_smoke(out_dir: str) -> dict:
+    """Exact-vs-twostage A/B on the fused p=1 threshold path (the ISSUE-6
+    tentpole's consumer): two tiny flat-gtopk sub-runs differing ONLY in
+    --topk-method, each with the recall audit on and two steps traced for
+    the paper's T_compute/T_select/T_comm split. Returns the fields the
+    main run logs as ONE "twostage" record so the drift gate can pin
+
+      audit_recall_twostage      twostage tau keeps a SUPERSET of the
+                                 exact top-k (tau_twostage <= tau_exact),
+                                 so the audited recall floor is ~1.0
+      select_frac_regression     max(0, frac_select_twostage -
+                                 frac_select_exact): one-sided "T_select
+                                 fraction no worse than exact" evidence
+
+    On a platform without usable op traces the frac fields are omitted
+    (same degradation as run_smoke's attr_error path)."""
+    from gtopkssgd_tpu.obs import report
+    from gtopkssgd_tpu.obs.trace_attr import attribute, capture
+    from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+    per_method: dict = {}
+    for method in ("exact", "twostage"):
+        sub = os.path.join(out_dir, f"twostage_ab_{method}")
+        cfg = TrainConfig(
+            dnn="resnet20", batch_size=4, nworkers=1,
+            compression="gtopk", density=0.01, seed=42,
+            max_epochs=1, log_interval=2, eval_batches=1,
+            obs_interval=1, obs_audit_interval=2,
+            topk_method=method, out_dir=sub)
+        with Trainer(cfg) as t:
+            t.train(2)  # audit fires at step 2 (obs_audit_interval=2)
+            trace_dir = os.path.join(sub, "trace")
+            try:
+                with capture(trace_dir):
+                    t.train(2)
+                frac = attribute(trace_dir, mode=method).get("frac_select")
+            except Exception:  # platform without usable op traces
+                frac = None
+        recs, _ = report.load_records(sub)
+        audited = [r["audit_recall"] for r in recs
+                   if r.get("kind") == "obs"
+                   and float(r.get("audit_recall", -1.0)) >= 0.0]
+        per_method[method] = {
+            "audit_recall": max(audited) if audited else -1.0,
+            "frac_select": frac,
+        }
+    rec = {
+        "audit_recall_exact": per_method["exact"]["audit_recall"],
+        "audit_recall_twostage": per_method["twostage"]["audit_recall"],
+    }
+    fs_e = per_method["exact"]["frac_select"]
+    fs_t = per_method["twostage"]["frac_select"]
+    if fs_e is not None and fs_t is not None:
+        rec["frac_select_exact"] = fs_e
+        rec["frac_select_twostage"] = fs_t
+        rec["select_frac_ratio"] = round(fs_t / max(fs_e, 1e-9), 4)
+        rec["select_frac_regression"] = round(max(0.0, fs_t - fs_e), 6)
+    return rec
+
+
 def run_smoke(out_dir: str) -> str:
     """Train the canonical run; returns the run dir (metrics.jsonl inside).
 
@@ -113,8 +173,11 @@ def run_smoke(out_dir: str) -> str:
     # Chaos sub-run first (its own Trainer, its own subdir), then the
     # main run re-logs ONLY the resilience records so the baseline can
     # pin recovery structure without the sub-run's train/obs rows
-    # polluting the main run's value statistics.
+    # polluting the main run's value statistics. The twostage A/B runs
+    # the same way: its sub-runs live in subdirs and only the single
+    # summary record enters this run's stream.
     rec_dir = run_recovery_smoke(out_dir)
+    twostage_rec = run_twostage_smoke(out_dir)
 
     cfg = smoke_config(out_dir)
     with Trainer(cfg) as t:
@@ -146,6 +209,9 @@ def run_smoke(out_dir: str) -> str:
                 t.metrics.log(r["kind"], **{
                     k: v for k, v in r.items()
                     if k not in ("kind", "time", "rank")})
+        # Same graft for the twostage A/B evidence: the gate pins the
+        # audited recall floor and the one-sided T_select regression.
+        t.metrics.log("twostage", **twostage_rec)
     return out_dir
 
 

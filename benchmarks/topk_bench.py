@@ -1,7 +1,7 @@
 """Top-k strategy sweep on real hardware — the evidence behind `auto`.
 
 The reference leans on `torch.topk`'s CUDA kernel (SURVEY.md §2 native
-table: the #1 custom-kernel obligation). The TPU rebuild has five
+table: the #1 custom-kernel obligation). The TPU rebuild has six
 strategies (ops/topk.py, ops/pallas_topk.py); this benchmark measures all
 of them at the reference's real problem sizes:
 
@@ -11,12 +11,31 @@ of them at the reference's real problem sizes:
 
 with k = ceil(rho * N) at rho in {0.001, 0.01}, and writes a JSON artifact
 (benchmarks/results/topk_bench_<device>.json) so the choice of the
-production method is reproducible, not folklore. Timing uses the same
-discipline as the main benchmark: back-to-back dispatch, one D2H fence
-(true_sync — block_until_ready lies on the tunneled TPU), fixed round trip
-subtracted, window >> round trip.
+production method is reproducible, not folklore. Each selection row also
+carries `recall_vs_exact` (exact-vs-method index recall on the same random
+vector) so approximate methods (approx, twostage, simrecall) are compared
+on both axes at once. `tau_*` rows time the tau-only API (ops.select_tau,
+what compress_by_threshold consumes at p=1) — no (vals, idx) set, no
+gather — with recall measured on the threshold MASK |x| >= tau (>= the
+index-set recall by the superset property).
 
-Run:  python -m benchmarks.topk_bench [--out PATH] [--quick]
+Timing uses the same discipline as the main benchmark: back-to-back
+dispatch, one D2H fence (true_sync — block_until_ready lies on the
+tunneled TPU), fixed round trip subtracted, window >> round trip.
+
+`--cpu-fallback` is the dead-tunnel mode bench.py invokes when the
+accelerator backend cannot initialize: it forces the in-process CPU mesh
+BEFORE any backend touch (this host's sitecustomize overrides
+JAX_PLATFORMS, so the config API is the only reliable override), runs the
+quick sweep with the Pallas kernels in interpret mode, tags the artifact
+`"backend": "cpu_fallback"`, and appends the one-pass counting evidence
+(largest compiled op is 1xN for the fused/bucketize counting pass vs 8xN
+for the vmapped 8-reduction it replaced) so BENCH rounds carry fresh,
+comparable selection data even with no chip attached. Interpret-mode ms
+are NOT device numbers — recall columns and op-size assertions are the
+meaningful fields there.
+
+Run:  python -m benchmarks.topk_bench [--out PATH] [--quick] [--cpu-fallback]
 """
 
 from __future__ import annotations
@@ -24,16 +43,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
+import sys
 
-import jax
-import jax.numpy as jnp
-
-from gtopkssgd_tpu.ops.topk import k_for_density, select_topk
-from gtopkssgd_tpu.utils import (
-    sync_round_trip_seconds,
-    timed_window,
-    true_sync,
-)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SIZES = {
     "resnet20-270k": 272_474,
@@ -41,20 +54,44 @@ SIZES = {
     "vgg16-61M": 61_090_496,
 }
 DENSITIES = (0.001, 0.01)
-METHODS = ("exact", "blockwise", "threshold", "approx", "pallas")
+METHODS = ("exact", "blockwise", "threshold", "approx", "pallas",
+           "twostage")
+# The tau-only consumers (compress_by_threshold at p=1) care about these.
+TAU_METHODS = ("exact", "threshold", "twostage")
 
 
-def time_method(method: str, n: int, k: int, min_seconds: float = 1.0):
-    x = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
+def _selector(method: str, k: int, interpret: bool):
+    import jax
+
+    from gtopkssgd_tpu.ops.pallas_topk import pallas_topk_abs
+    from gtopkssgd_tpu.ops.topk import (
+        select_tau, select_topk, twostage_topk_abs,
+    )
 
     if method == "pallas":
-        from gtopkssgd_tpu.ops.pallas_topk import pallas_topk_abs
+        return jax.jit(lambda v: pallas_topk_abs(v, k, interpret=interpret))
+    if method == "twostage" and interpret:
+        # Exercise the fused kernel (not the XLA reference) even off-TPU.
+        return jax.jit(lambda v: twostage_topk_abs(
+            v, k, use_pallas=True, interpret=True))
+    if method.startswith("tau_"):
+        return jax.jit(lambda v: select_tau(v, k, method[4:]))
+    return jax.jit(lambda v: select_topk(v, k, method=method))
 
-        interpret = jax.default_backend() != "tpu"
-        fn = jax.jit(lambda v: pallas_topk_abs(v, k, interpret=interpret))
-    else:
-        fn = jax.jit(lambda v: select_topk(v, k, method=method))
 
+def time_method(method: str, n: int, k: int, min_seconds: float = 1.0,
+                interpret: bool = False):
+    import jax
+    import jax.numpy as jnp
+
+    from gtopkssgd_tpu.utils import (
+        sync_round_trip_seconds,
+        timed_window,
+        true_sync,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
+    fn = _selector(method, k, interpret)
     out = fn(x)
     rtt = sync_round_trip_seconds(out)
 
@@ -67,56 +104,161 @@ def time_method(method: str, n: int, k: int, min_seconds: float = 1.0):
     return timed_window(chunk, rtt, min_seconds, 4)
 
 
-def main():
-    from gtopkssgd_tpu.utils import enable_compilation_cache
+def recall_vs_exact(method: str, n: int, k: int, interpret: bool) -> float:
+    """Index recall (tau rows: mask recall) of `method` against exact
+    top-k on the same vector the timing loop used."""
+    import numpy as np
 
-    enable_compilation_cache()
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default=None)
-    ap.add_argument("--quick", action="store_true",
-                    help="one size, one density, short windows")
-    ap.add_argument("--min-seconds", type=float, default=1.0)
-    args = ap.parse_args()
+    import jax
+    import jax.numpy as jnp
 
-    device = jax.devices()[0].device_kind.replace(" ", "_")
-    sizes = dict(list(SIZES.items())[:1]) if args.quick else SIZES
-    densities = DENSITIES[:1] if args.quick else DENSITIES
-    min_s = 0.3 if args.quick else args.min_seconds
+    from gtopkssgd_tpu.ops.topk import topk_abs
 
+    x = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
+    _, exact_idx = topk_abs(x, k)
+    exact_idx = np.asarray(exact_idx)
+    out = _selector(method, k, interpret)(x)
+    if method.startswith("tau_"):
+        tau = float(out)
+        hit = np.abs(np.asarray(x)[exact_idx]) >= tau
+        return float(hit.mean())
+    _, idx = out
+    return float(
+        len(set(np.asarray(idx).tolist()) & set(exact_idx.tolist())) / k)
+
+
+def one_pass_evidence(n: int) -> dict:
+    """Committed proof that the counting pass reads x ONCE.
+
+    Compares the largest operand/result element count in the compiled
+    HLO of the production count_fn (ops.topk.bucketize_counts — the XLA
+    twin of the fused Pallas counting kernel) against the vmapped
+    8-reduction it replaced: the old formulation materializes/loops an
+    8xN compare, the single-pass one never exceeds 1xN. Returns the op
+    sizes plus the boolean the gate asserts."""
+    import jax
+    import jax.numpy as jnp
+
+    from gtopkssgd_tpu.ops.topk import bucketize_counts
+
+    x = jnp.ones((n,), jnp.float32)
+    thr = jnp.linspace(0.1, 0.9, 8)
+
+    def vmap8(mag, t):
+        return jax.vmap(lambda tt: jnp.sum((mag >= tt).astype(jnp.int32)))(t)
+
+    def max_elems(fn):
+        txt = jax.jit(fn).lower(x, thr).compile().as_text()
+        best = 0
+        for m in re.finditer(r"\b(?:f32|s32|s64|pred|u32|u8|s8)\[([\d,]+)\]",
+                             txt):
+            elems = 1
+            for d in m.group(1).split(","):
+                if d:
+                    elems *= int(d)
+            best = max(best, elems)
+        return best
+
+    single = max_elems(bucketize_counts)
+    vmapped = max_elems(vmap8)
+    return {
+        "n": n,
+        "bucketize_max_op_elems": single,
+        "vmap8_max_op_elems": vmapped,
+        "bucketize_passes_over_x": round(single / n, 2),
+        "vmap8_passes_over_x": round(vmapped / n, 2),
+        "single_pass": bool(single <= 2 * n < vmapped),
+    }
+
+
+def run_sweep(quick: bool, min_seconds: float, interpret: bool,
+              with_recall: bool = True):
+    from gtopkssgd_tpu.ops.topk import k_for_density
+
+    sizes = dict(list(SIZES.items())[:1]) if quick else SIZES
+    densities = DENSITIES[:1] if quick else DENSITIES
     rows = []
     for label, n in sizes.items():
         for rho in densities:
             k = k_for_density(n, rho)
-            for method in METHODS:
+            for method in METHODS + tuple(
+                    f"tau_{m}" for m in TAU_METHODS):
                 try:
-                    sec, steps = time_method(method, n, k, min_s)
+                    sec, steps = time_method(
+                        method, n, k, min_seconds, interpret)
+                    rec = (recall_vs_exact(method, n, k, interpret)
+                           if with_recall else None)
                     err = None
                 except Exception as e:  # record, don't abort the sweep
-                    sec, steps, err = None, 0, f"{type(e).__name__}: {e}"
+                    sec, steps, rec = None, 0, None
+                    err = f"{type(e).__name__}: {e}"
                 rows.append({
                     "size": label, "n": n, "density": rho, "k": k,
                     "method": method, "ms": (
                         round(sec * 1e3, 4) if sec is not None else None),
+                    "recall_vs_exact": (
+                        round(rec, 4) if rec is not None else None),
                     "steps_timed": steps, "error": err,
                 })
                 ms = f"{sec * 1e3:9.3f} ms" if sec is not None else "FAILED"
-                print(f"{label:16s} rho={rho:<6g} {method:10s} {ms}",
+                rc = f" recall={rec:.4f}" if rec is not None else ""
+                print(f"{label:16s} rho={rho:<6g} {method:13s} {ms}{rc}",
                       flush=True)
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--quick", action="store_true",
+                    help="one size, one density, short windows")
+    ap.add_argument("--cpu-fallback", action="store_true",
+                    help="dead-tunnel mode: force the CPU mesh before "
+                         "backend init, quick sweep, interpret-mode "
+                         "kernels, provenance-tagged artifact")
+    ap.add_argument("--min-seconds", type=float, default=1.0)
+    args = ap.parse_args(argv)
+
+    if args.cpu_fallback:
+        # Must run before ANY jax backend touch: sitecustomize overrides
+        # JAX_PLATFORMS on this host, so only the config API sticks.
+        from gtopkssgd_tpu.utils import force_cpu_mesh
+
+        force_cpu_mesh(1)
+        args.quick = True
+
+    import jax
+
+    from gtopkssgd_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    device = jax.devices()[0].device_kind.replace(" ", "_")
+    interpret = jax.default_backend() != "tpu"
+    min_s = 0.3 if (args.quick or args.cpu_fallback) else args.min_seconds
+
+    rows = run_sweep(args.quick, min_s, interpret)
 
     result = {
         "device_kind": jax.devices()[0].device_kind,
-        "backend": jax.default_backend(),
-        "pallas_interpret": jax.default_backend() != "tpu",
+        "backend": ("cpu_fallback" if args.cpu_fallback
+                    else jax.default_backend()),
+        "pallas_interpret": interpret,
         "rows": rows,
     }
+    if args.cpu_fallback:
+        result["one_pass_evidence"] = one_pass_evidence(
+            list(SIZES.values())[0])
+
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
-        "results", f"topk_bench_{device}.json",
+        "results",
+        f"topk_bench_{'cpu_fallback' if args.cpu_fallback else device}.json",
     )
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
     print(f"wrote {out}")
+    return out
 
 
 if __name__ == "__main__":

@@ -348,7 +348,9 @@ def measure_breakdown(cfg: BenchConfig, mode: Optional[str],
 
     def compress(flat, residual):
         acc = compressor.accumulate(flat, residual)
-        return compressor.compress(acc)
+        # Unfused operands let the twostage kernel fold the accumulate
+        # into its stage-1 pass (no-op for the other methods).
+        return compressor.compress(acc, grad=flat, residual=residual)
 
     hier_ici = cfg.hier_ici if mode in HIER_MODES else 1
 
@@ -484,8 +486,8 @@ def _measure_breakdown_layerwise(cfg: BenchConfig, mode: str,
     def compress_per_leaf(grads, residual):
         flats = [g.reshape(-1) for g in jax.tree.leaves(grads)]
         accs = [f + r for f, r in zip(flats, residual)]
-        sel = [select_topk(a, kl, cfg.topk_method)
-               for a, kl in zip(accs, ks)]
+        sel = [select_topk(f, kl, cfg.topk_method, residual=r)
+               for f, r, kl in zip(flats, residual, ks)]
         new_res = tuple(a.at[i].set(0.0, mode="drop")
                         for a, (_, i) in zip(accs, sel))
         vals = jnp.concatenate([v for v, _ in sel])

@@ -34,6 +34,7 @@ from gtopkssgd_tpu import modes
 from gtopkssgd_tpu.ops import (
     k_for_density,
     membership_mask,
+    select_tau,
     select_topk,
 )
 
@@ -45,7 +46,8 @@ class TopKCompressor:
     """Magnitude top-k with error feedback. `density` = k / N (reference flag
     `--density`, rho, typically 1e-3). `method` picks the selection kernel
     (see ops.topk.select_topk): auto | exact | blockwise | approx | pallas
-    | simrecall (the CPU-runnable pessimistic approx stand-in)."""
+    | twostage (fused two-stage bucket select, arXiv:2506.04165) |
+    simrecall (the CPU-runnable pessimistic approx stand-in)."""
 
     density: float
     method: str = "auto"
@@ -60,18 +62,40 @@ class TopKCompressor:
         """acc = grad + residual (the error-feedback accumulation)."""
         return grad_flat + residual
 
-    def compress(self, acc: Array) -> Tuple[Array, Array, Array]:
+    def compress(
+        self,
+        acc: Array,
+        *,
+        grad: Optional[Array] = None,
+        residual: Optional[Array] = None,
+    ) -> Tuple[Array, Array, Array]:
         """Select top-k of |acc|; residual keeps everything not selected.
 
         Returns (vals f32[k], idx i32[k], residual f32[N]).
+
+        When the caller passes the unfused operands (`grad`, `residual`
+        with acc == grad + residual), the selection reads them directly —
+        the `twostage` kernel folds the error-feedback accumulate into
+        its own stage-1 HBM pass instead of consuming a materialized
+        accumulator (the other methods fold in XLA; same values either
+        way). The returned residual is still acc with the selected
+        entries zeroed.
         """
         n = acc.shape[0]
-        vals, idx = select_topk(acc, self.k(n), self.method)
-        residual = acc.at[idx].set(0.0, mode="drop")
-        return vals, idx, residual
+        if grad is not None:
+            vals, idx = select_topk(grad, self.k(n), self.method,
+                                    residual=residual)
+        else:
+            vals, idx = select_topk(acc, self.k(n), self.method)
+        residual_out = acc.at[idx].set(0.0, mode="drop")
+        return vals, idx, residual_out
 
     def compress_by_threshold(
-        self, acc: Array
+        self,
+        acc: Array,
+        *,
+        grad: Optional[Array] = None,
+        residual: Optional[Array] = None,
     ) -> Tuple[Array, Array, Array]:
         """Mask-form selection for paths that need no wire format.
 
@@ -107,10 +131,20 @@ class TopKCompressor:
         the keep set rather than selected: |x| >= 0 is vacuously true,
         and "select all" would e.g. zero an entire velocity buffer under
         momentum correction instead of touching <=k coordinates like the
-        index form does."""
+        index form does.
+
+        tau comes from the tau-only API (ops.select_tau) — no k-sized
+        (vals, idx) set is materialized and no gather runs just to read
+        one scalar. When the caller passes the unfused operands (`grad`,
+        `residual` with acc == grad + residual), the tau search reads
+        them directly, fusing the error-feedback accumulate into the
+        selection pass for the twostage/pallas kernels."""
         n = acc.shape[0]
-        vals, _ = select_topk(acc, self.k(n), self.method)
-        tau = jnp.min(jnp.abs(vals))
+        if grad is not None:
+            tau = select_tau(grad, self.k(n), self.method,
+                             residual=residual)
+        else:
+            tau = select_tau(acc, self.k(n), self.method)
         keep = (jnp.abs(acc) >= tau) & (jnp.abs(acc) > 0.0)
         kept_tau = jnp.min(jnp.where(keep, jnp.abs(acc), jnp.inf))
         kept_tau = jnp.where(
@@ -160,7 +194,9 @@ class NoneCompressor:
     def accumulate(self, grad_flat: Array, residual: Array) -> Array:
         return grad_flat
 
-    def compress(self, acc: Array) -> Tuple[Array, Array, Array]:
+    def compress(self, acc: Array, *, grad: Optional[Array] = None,
+                 residual: Optional[Array] = None
+                 ) -> Tuple[Array, Array, Array]:
         n = acc.shape[0]
         idx = jnp.arange(n, dtype=jnp.int32)
         return acc, idx, jnp.zeros((0,), acc.dtype)

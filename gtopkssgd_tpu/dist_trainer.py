@@ -131,7 +131,8 @@ def build_argparser() -> argparse.ArgumentParser:
                         "slices)")
     p.add_argument("--topk-method", default="auto",
                    choices=["auto", "exact", "blockwise", "approx",
-                            "threshold", "pallas"])
+                            "threshold", "pallas", "twostage",
+                            "simrecall"])
     p.add_argument("--clip-grad-norm", type=float, default=None)
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="optimizer steps per jitted dispatch (lax.scan "

@@ -12,12 +12,16 @@ from gtopkssgd_tpu.ops.topk import (
     approx_topk_abs,
     threshold_topk_abs,
     simrecall_topk_abs,
+    twostage_topk_abs,
+    bucketize_counts,
     select_topk,
+    select_tau,
     k_for_density,
     merge_sparse_sets,
     scatter_add_dense,
     membership_mask,
     SENTINEL_DTYPE,
+    TWOSTAGE_OVERSAMPLE,
 )
 
 __all__ = [
@@ -26,10 +30,14 @@ __all__ = [
     "approx_topk_abs",
     "threshold_topk_abs",
     "simrecall_topk_abs",
+    "twostage_topk_abs",
+    "bucketize_counts",
     "select_topk",
+    "select_tau",
     "k_for_density",
     "merge_sparse_sets",
     "scatter_add_dense",
     "membership_mask",
     "SENTINEL_DTYPE",
+    "TWOSTAGE_OVERSAMPLE",
 ]

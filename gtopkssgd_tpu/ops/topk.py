@@ -13,6 +13,15 @@ numpy on the host. Here both live on the TPU:
                             vector. Used by default for N above a threshold.
   * `approx_topk_abs`    -- `lax.approx_max_k` (TPU-optimized, recall<1);
                             opt-in, changes semantics slightly.
+  * `twostage_topk_abs`  -- generalized two-stage approximate top-k
+                            (arXiv:2506.04165): one pass emitting per-bucket
+                            max candidates (Pallas-fused with the error-
+                            feedback accumulate on TPU), then a small exact
+                            reselect. Recall ~= 1 - k/(2L); misses stay in
+                            the residual (arXiv:1911.08772).
+  * `select_tau`         -- tau-only API: the k-th |value| threshold without
+                            materializing a k-sized (vals, idx) set, for
+                            threshold-mask consumers (compress_by_threshold).
   * `merge_sparse_sets`  -- the per-round merge of the gTop-k tree: sparse sum
                             of two k-sized unique-index sets, then reselect.
 
@@ -25,7 +34,8 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Tuple
+import os
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -103,6 +113,24 @@ def approx_topk_abs(x: Array, k: int, recall_target: float = 0.95) -> Tuple[Arra
     return vals, idx
 
 
+def bucketize_counts(mag: Array, thr: Array) -> Array:
+    """counts[i] = #{ j : mag[j] >= thr[i] } for all 8 thresholds in ONE
+    logical pass over `mag` (the XLA analogue of the fused Pallas
+    counting kernel; previously this was a vmapped 8-reduction = 8 HBM
+    passes). Sort the thresholds, bucketize every magnitude with one
+    `searchsorted`, histogram the bucket ids, and read each threshold's
+    count as a suffix sum: mag >= thr_sorted[i]  iff  its bucket id
+    (#thresholds <= mag) is > i."""
+    nthr = thr.shape[0]
+    order = jnp.argsort(thr)
+    ts = jnp.take(thr, order)
+    bucket = jnp.searchsorted(ts, mag, side="right")  # #{ts <= mag_j}
+    hist = jnp.zeros((nthr + 1,), jnp.int32).at[bucket].add(1)
+    ge = jnp.cumsum(hist[::-1])[::-1]  # ge[i] = #{bucket >= i}
+    counts_sorted = ge[1:]  # threshold i (sorted) needs bucket >= i+1
+    return jnp.zeros((nthr,), jnp.int32).at[order].set(counts_sorted)
+
+
 def threshold_topk_abs(x: Array, k: int, count_fn=None) -> Tuple[Array, Array]:
     """Magnitude top-k by threshold multisection + compaction ("threshold-
     estimate + compact", SURVEY.md §2 native-obligations table).
@@ -126,11 +154,7 @@ def threshold_topk_abs(x: Array, k: int, count_fn=None) -> Tuple[Array, Array]:
     if k >= n:
         return topk_abs(x, k)
     if count_fn is None:
-        # XLA reference: one reduction per threshold (8 passes/round); the
-        # Pallas kernel replaces this with one fused pass per round.
-        count_fn = lambda mag, thr: jax.vmap(
-            lambda t: jnp.sum((mag >= t).astype(jnp.int32))
-        )(thr)
+        count_fn = bucketize_counts
     mag = jnp.abs(x)
     maxv = jnp.max(mag)
     lo = jnp.zeros((), x.dtype)
@@ -218,17 +242,245 @@ def simrecall_topk_abs(x: Array, k: int,
     return out_val[:k], out_idx[:k]
 
 
+# Stage-1 bucket count target: L ~= TWOSTAGE_OVERSAMPLE * k buckets. With
+# top-1-per-bucket selection over a random placement, the expected recall
+# is ~= 1 - (k-1)/(2L) (a true top-k element is only lost to a LARGER
+# element sharing its bucket, and ranks above it are uniform over buckets)
+# -> ~0.97 at oversample 16, comfortably above the 0.95 audit floor.
+TWOSTAGE_OVERSAMPLE = 16
+
+
+def _twostage_pallas_groups(n: int, k: int, oversample: int) -> int:
+    """Row-groups per (BLOCK_ROWS, 128) tile for the Pallas stage-1 pass.
+
+    Miss probability is governed by the bucket SIZE (rpg = BLOCK_ROWS /
+    groups elements per bucket), not the raw bucket count: tail padding
+    inflates L without shrinking the buckets real elements live in. Keep
+    rpg <= n/(oversample*k) so expected misses stay ~k/(2*oversample)
+    (padding-heavy buckets only get safer). Power-of-two divisor of
+    BLOCK_ROWS; at groups == BLOCK_ROWS every element is its own bucket
+    and the method degenerates to exact."""
+    from gtopkssgd_tpu.ops.pallas_topk import BLOCK_ROWS, _BLOCK, _LANES
+
+    nblocks = max(1, -(-n // _BLOCK))
+    target_rpg = max(1, n // max(1, oversample * k))
+    g = 1
+    while BLOCK_ROWS // g > target_rpg and g < BLOCK_ROWS:
+        g *= 2
+    while nblocks * g * _LANES < k and g < BLOCK_ROWS:
+        g *= 2
+    return g
+
+
+def _twostage_candidates(
+    x: Array,
+    k: int,
+    *,
+    residual: Optional[Array] = None,
+    oversample: int = TWOSTAGE_OVERSAMPLE,
+    use_pallas: Optional[bool] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[Array, Array]:
+    """Stage 1 of the two-stage select: per-bucket max-|acc| candidates
+    (cand_val f32[L], cand_idx i32[L]) with acc = x (+ residual), L >= k.
+    Candidate indices >= n mark padding buckets (value 0)."""
+    n = x.shape[0]
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas or interpret:
+        from gtopkssgd_tpu.ops.pallas_topk import fused_stage1_candidates
+
+        groups = _twostage_pallas_groups(n, k, oversample)
+        interp = (jax.default_backend() != "tpu"
+                  if interpret is None else interpret)
+        cand_val, cand_idx, _ = fused_stage1_candidates(
+            x, residual=residual, groups=groups, interpret=interp)
+        return cand_val, cand_idx
+    # XLA reference: reshape to (b, L) so bucket j holds flat indices
+    # {j, L+j, 2L+j, ...} — the stride-L interleave decorrelates
+    # contiguous layer slices — and take one argmax per column. Same
+    # bucket-top-1 semantics as the kernel, different bucket membership.
+    acc = x if residual is None else x + residual
+    L = max(k, min(n, oversample * k))
+    b = -(-n // L)
+    accp = jnp.pad(acc, (0, b * L - n))
+    mat = accp.reshape(b, L)
+    rows = jnp.arange(b, dtype=SENTINEL_DTYPE)[:, None]
+    cols = jnp.arange(L, dtype=SENTINEL_DTYPE)[None, :]
+    mag = jnp.where(rows * L + cols < n, jnp.abs(mat), -1.0)
+    win = jnp.argmax(mag, axis=0)  # first max row: deterministic ties
+    cand_idx = (win.astype(SENTINEL_DTYPE) * L
+                + jnp.arange(L, dtype=SENTINEL_DTYPE))
+    cand_val = jnp.take_along_axis(mat, win[None, :], axis=0)[0]
+    return cand_val, cand_idx
+
+
+def twostage_topk_abs(
+    x: Array,
+    k: int,
+    *,
+    residual: Optional[Array] = None,
+    oversample: int = TWOSTAGE_OVERSAMPLE,
+    use_pallas: Optional[bool] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[Array, Array]:
+    """Generalized two-stage approximate magnitude top-k (arXiv:2506.04165
+    lineage; the gTop-k-ready variant of `blockwise_topk_abs`).
+
+    Stage 1 reads x ONCE and keeps only each bucket's max-|acc| element
+    (L ~= oversample*k buckets); stage 2 exactly reselects the top-k of
+    the <= L candidates. Unlike `blockwise_topk_abs` (per-block top-k,
+    exact, but a large `lax.top_k` per block), stage 1 here is a pure
+    max/argmax reduction — on TPU it runs as the fused Pallas kernel
+    (ops.pallas_topk.fused_stage1_candidates) which also folds the
+    error-feedback accumulate `x + residual` into the same HBM pass, so
+    the flat [N] accumulator is never materialized.
+
+    Approximation: a true top-k element is missed only when a LARGER
+    element shares its bucket — expected recall ~= 1 - (k-1)/(2L)
+    (~0.97 at the default oversample). Error feedback absorbs misses
+    (arXiv:1911.08772), the same argument that admits `approx`.
+
+    `residual`, when given, is added to x INSIDE the selection pass;
+    returned values are read from acc = x + residual.
+    """
+    n = x.shape[0]
+    if k >= n:
+        acc = x if residual is None else x + residual
+        vals, idx = topk_abs(acc, n)
+        if k > n:
+            vals = jnp.pad(vals, (0, k - n))
+            idx = jnp.pad(idx, (0, k - n), constant_values=n)
+        return vals, idx
+    cand_val, cand_idx = _twostage_candidates(
+        x, k, residual=residual, oversample=oversample,
+        use_pallas=use_pallas, interpret=interpret)
+    _, sel = lax.top_k(jnp.abs(cand_val), k)
+    idx = jnp.take(cand_idx, sel)
+    vals = jnp.take(cand_val, sel)
+    oob = idx >= n
+    idx = jnp.where(oob, n, idx).astype(SENTINEL_DTYPE)
+    vals = jnp.where(oob, 0.0, vals)
+    return vals, idx
+
+
+def _threshold_tau(x: Array, k: int, count_fn=None) -> Array:
+    """tau for the threshold family without building an index set: the
+    same multisection bracket as `threshold_topk_abs`, then compact the
+    surviving MAGNITUDES (no values, no indices, no gather) and read the
+    k-th largest. Degenerate tie behavior (survivors > cap) matches
+    threshold_topk_abs by construction — same bracket, same cap."""
+    n = x.shape[0]
+    mag = jnp.abs(x)
+    if k >= n:
+        return jnp.min(mag)
+    if count_fn is None:
+        count_fn = bucketize_counts
+    maxv = jnp.max(mag)
+    lo = jnp.zeros((), x.dtype)
+    hi = maxv
+    for _ in range(4):
+        lo_eff = jnp.maximum(lo, maxv * 1e-12 + 1e-30)
+        r = (lo_eff / (hi + 1e-30)) ** (1.0 / 9.0)
+        powers = jnp.arange(1, 9, dtype=x.dtype)
+        thr = hi * r ** powers
+        counts = count_fn(mag, thr)
+        ge = counts >= k
+        lo = jnp.maximum(lo, jnp.max(jnp.where(ge, thr, lo)))
+        hi = jnp.minimum(hi, jnp.min(jnp.where(ge, hi, thr)))
+    cap = min(n, max(2 * k, k + 4096))
+    selected = mag >= lo
+    pos = jnp.cumsum(selected.astype(jnp.int32)) - 1
+    slot = jnp.where(selected, pos, cap)
+    buf_m = jnp.zeros((cap,), x.dtype).at[slot].set(mag, mode="drop")
+    return lax.top_k(buf_m, k)[0][k - 1]
+
+
+def select_tau(
+    x: Array,
+    k: int,
+    method: str = "auto",
+    *,
+    residual: Optional[Array] = None,
+) -> Array:
+    """The selection threshold tau — the smallest magnitude the configured
+    kernel would select — WITHOUT materializing a k-sized (vals, idx) set
+    or gathering values. Threshold-mask consumers (TopKCompressor.
+    compress_by_threshold, the p=1 paths in optimizer.py) build their
+    keep mask as |acc| >= tau directly from this scalar.
+
+    Per method, tau equals min(|vals|) of the (vals, idx) set the
+    corresponding `select_topk` would return — the existing mask
+    semantics (boundary ties all pass; for approximate kernels the mask
+    is a superset of the index set, recall >= the kernel's) carry over
+    unchanged. For `twostage`, tau is the k-th largest CANDIDATE
+    magnitude, which is >= the value of overall rank k+misses, so the
+    mask |acc| >= tau still contains every candidate the two-stage
+    reselect would keep.
+
+    `residual`, when given, is the error-feedback residual: tau is
+    computed over acc = x + residual (fused into the stage-1/counting
+    kernel pass for twostage/pallas; folded by XLA otherwise).
+    """
+    n = x.shape[0]
+    if method == "auto":
+        method = _resolve_auto(n)
+    if method == "twostage":
+        if k >= n:
+            acc = x if residual is None else x + residual
+            return jnp.min(jnp.abs(acc))
+        cand_val, _ = _twostage_candidates(x, k, residual=residual)
+        return lax.top_k(jnp.abs(cand_val), k)[0][k - 1]
+    acc = x if residual is None else x + residual
+    if k >= n:
+        return jnp.min(jnp.abs(acc))
+    if method == "exact":
+        return lax.top_k(jnp.abs(acc), k)[0][k - 1]
+    if method == "approx":
+        vals, _ = lax.approx_max_k(jnp.abs(acc), k, recall_target=0.95)
+        return jnp.min(vals)
+    if method == "blockwise":
+        num_blocks = max(1, n // 65536)
+        block = -(-n // num_blocks)
+        kb = min(k, block)
+        mag = jnp.abs(jnp.pad(acc, (0, block * num_blocks - n)))
+        cand = lax.top_k(mag.reshape(num_blocks, block), kb)[0]
+        return lax.top_k(cand.reshape(-1), k)[0][k - 1]
+    if method == "threshold":
+        return _threshold_tau(acc, k)
+    if method == "pallas":
+        from gtopkssgd_tpu.ops.pallas_topk import (
+            fused_multi_threshold_count,
+        )
+
+        interp = jax.default_backend() != "tpu"
+        # The count rounds read grad (+ residual) through the fused
+        # kernel; only the final compaction touches the folded acc.
+        count_fn = lambda _mag, thr: fused_multi_threshold_count(
+            x, thr, residual, interpret=interp)
+        return _threshold_tau(acc, k, count_fn=count_fn)
+    if method == "simrecall":
+        vals, _ = simrecall_topk_abs(acc, k)
+        return jnp.min(jnp.abs(vals))
+    raise ValueError(f"unknown topk method {method!r}")
+
+
 _METHODS = {
     "exact": lambda x, k: topk_abs(x, k),
     "blockwise": lambda x, k: blockwise_topk_abs(x, k),
     "approx": lambda x, k: approx_topk_abs(x, k),
     "threshold": lambda x, k: threshold_topk_abs(x, k),
     "simrecall": lambda x, k: simrecall_topk_abs(x, k),
+    "twostage": lambda x, k: twostage_topk_abs(x, k),
 }
 
-# Above this N, "auto" switches from exact lax.top_k to lax.approx_max_k.
-# Measured on the real TPU v5e chip (benchmarks/results/
-# topk_bench_TPU_v5_lite.json, benchmarks/topk_bench.py to reproduce):
+# Above this N, "auto" switches from exact lax.top_k to an approximate
+# kernel. Measured on the real TPU v5e chip (benchmarks/results/
+# topk_bench_TPU_v5_lite.json; regenerate with
+# `python benchmarks/topk_bench.py` on hardware — the committed rows
+# predate the twostage kernel, whose on-chip columns land at the next
+# tunnel revival; CPU-fallback rows carry interpret-mode recall in the
+# meantime, benchmarks/results/topk_bench_cpu_fallback.json):
 #
 #     N      rho    exact    blockwise  threshold  approx   pallas
 #     272k   0.001  0.40 ms   0.37 ms    3.25 ms   0.16 ms  3.26 ms
@@ -245,19 +497,49 @@ _METHODS = {
 # (merge_sparse_sets) stays EXACT, so replicas remain in lockstep. Force
 # --topk-method exact to reproduce the reference's exact-selection
 # semantics at any size.
+#
+# `twostage` targets the same >AUTO_APPROX_THRESHOLD regime as approx but
+# additionally fuses the error-feedback accumulate into its single
+# stage-1 pass and feeds the tau-only path (select_tau) — the properties
+# the p=1 threshold-mask pipeline needs. GTOPK_AUTO_TWOSTAGE=1 makes
+# `auto` prefer it over approx at large N; flip the default only with
+# fresh on-chip twostage rows from benchmarks/topk_bench.py.
 AUTO_APPROX_THRESHOLD = 1 << 20
+AUTO_TWOSTAGE = os.environ.get("GTOPK_AUTO_TWOSTAGE", "") == "1"
 
 
-def select_topk(x: Array, k: int, method: str = "auto") -> Tuple[Array, Array]:
+def _resolve_auto(n: int) -> str:
+    """The `auto` policy, shared by select_topk and select_tau."""
+    if n <= AUTO_APPROX_THRESHOLD:
+        return "exact"
+    return "twostage" if AUTO_TWOSTAGE else "approx"
+
+
+def select_topk(
+    x: Array,
+    k: int,
+    method: str = "auto",
+    *,
+    residual: Optional[Array] = None,
+) -> Tuple[Array, Array]:
     """Dispatch on top-k strategy.
 
     "auto" picks exact `lax.top_k` for small N (cost is noise there) and
-    `lax.approx_max_k` above AUTO_APPROX_THRESHOLD — see the measured
+    an approximate kernel above AUTO_APPROX_THRESHOLD — see the measured
     table above; do not change the policy without re-running
     benchmarks/topk_bench.py on hardware.
+
+    `residual`, when given, selects over acc = x + residual; the
+    `twostage` method folds the add into its fused stage-1 pass (the
+    accumulator is never materialized), every other method folds it in
+    XLA before selecting. Returned values are read from acc either way.
     """
     if method == "auto":
-        method = "exact" if x.shape[0] <= AUTO_APPROX_THRESHOLD else "approx"
+        method = _resolve_auto(x.shape[0])
+    if method == "twostage":
+        return twostage_topk_abs(x, k, residual=residual)
+    if residual is not None:
+        x = x + residual
     if method == "pallas":
         from gtopkssgd_tpu.ops.pallas_topk import pallas_topk_abs
 

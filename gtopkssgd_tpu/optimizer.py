@@ -442,7 +442,9 @@ def gtopk_sgd(
                 # per-leaf k = ceil(density * n_l) is exactly
                 # compressor.k(n_l), so the shared helper applies
                 # unchanged leaf by leaf.
-                sel = [compressor.compress_by_threshold(a) for a in accs]
+                sel = [compressor.compress_by_threshold(
+                           a, grad=s, residual=r)
+                       for a, s, r in zip(accs, srcs, res_in)]
                 keeps = [keep for keep, _, _ in sel]
                 new_res = [r for _, r, _ in sel]
                 u_out = (tuple(jnp.where(m, 0.0, u)
@@ -474,8 +476,8 @@ def gtopk_sgd(
                                 jnp.concatenate(keeps), ei, mode="clip"))
                     tel = (tel,)
                 return (dense_fl, tuple(new_res), u_out) + tel
-            sel = [select_topk(a, kl, topk_method)
-                   for a, kl in zip(accs, ks)]
+            sel = [select_topk(s, kl, topk_method, residual=r)
+                   for s, r, kl in zip(srcs, res_in, ks)]
             idx_l = [i for _, i in sel]
             new_res = [a.at[i].set(0.0, mode="drop")
                        for a, i in zip(accs, idx_l)]
@@ -709,9 +711,14 @@ def gtopk_sgd(
                     # compress cost — fused_variants artifact; the
                     # before/after is in the round-3 bench artifact).
                     # Masking u at the same keep-mask is exact here:
-                    # every local pick is delivered at p=1.
+                    # every local pick is delivered at p=1. The tau
+                    # search reads (src, residual_in) unfused so the
+                    # twostage/pallas kernels fold the error-feedback
+                    # accumulate into their own selection pass — acc
+                    # only feeds the elementwise masks, which XLA fuses.
                     keep, residual, tau_th = (
-                        compressor.compress_by_threshold(acc))
+                        compressor.compress_by_threshold(
+                            acc, grad=src, residual=residual_in))
                     dense = acc - residual
                     u_out = (jnp.where(keep, 0.0, u_in)
                              if correction else u_in)
@@ -731,7 +738,8 @@ def gtopk_sgd(
                                     keep, ei, mode="clip"))
                         tel = (tel,)
                 else:
-                    vals, idx, residual = compressor.compress(acc)
+                    vals, idx, residual = compressor.compress(
+                        acc, grad=src, residual=residual_in)
                     if telemetry:
                         # Selection stats describe the LOCAL selection
                         # (what this device put on the wire); the pmean

@@ -52,6 +52,8 @@ KINDS = frozenset({
     "inject",      # injected-fault firings (resilience/inject.py)
     "recovery",    # recovery actions + end-of-run summary
                    # (resilience/policy.py, trainer emergency save)
+    "twostage",    # twostage-vs-exact A/B evidence row (gate smoke):
+                   # audit recall + T_select fractions for both methods
 })
 
 _SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")

@@ -159,3 +159,59 @@ def test_compress_by_threshold_superset_of_kernel_selection(rng):
         acc, comp.k(n), comp.method
     )
     assert np.asarray(keep)[np.asarray(idx)].all()
+
+
+def test_compress_by_threshold_select_tau_partition_parity(rng):
+    """compress_by_threshold's tau now comes from the tau-only API
+    (ops.select_tau — no (vals, idx) set, no gather); per method the
+    keep/residual partition must be IDENTICAL to the legacy formulation
+    that built the mask from min|vals| of the corresponding select_topk."""
+    from gtopkssgd_tpu.ops import select_topk
+
+    n = 8192
+    acc = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    for method in ("exact", "blockwise", "approx", "threshold"):
+        comp = TopKCompressor(density=0.01, method=method)
+        keep, res, kept_tau = comp.compress_by_threshold(acc)
+        vals, _ = select_topk(acc, comp.k(n), method)
+        tau_ref = float(np.abs(np.asarray(vals)).min())
+        want = (np.abs(np.asarray(acc)) >= tau_ref) & (
+            np.abs(np.asarray(acc)) > 0.0)
+        np.testing.assert_array_equal(np.asarray(keep), want, err_msg=method)
+        np.testing.assert_array_equal(
+            np.where(want, 0.0, np.asarray(acc)), np.asarray(res),
+            err_msg=method)
+
+
+def test_compress_by_threshold_fused_operands_same_partition(rng):
+    """Passing the unfused operands (grad, residual with
+    acc == grad + residual) must yield the exact same partition as the
+    materialized-accumulator call — the fused path changes WHERE the
+    accumulate happens, never the selected set."""
+    n = 4096
+    g = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    r = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    acc = g + r
+    comp = TopKCompressor(density=0.01, method="exact")
+    keep_a, res_a, tau_a = comp.compress_by_threshold(acc)
+    keep_b, res_b, tau_b = comp.compress_by_threshold(
+        acc, grad=g, residual=r)
+    np.testing.assert_array_equal(np.asarray(keep_a), np.asarray(keep_b))
+    np.testing.assert_array_equal(np.asarray(res_a), np.asarray(res_b))
+    assert float(tau_a) == float(tau_b)
+
+
+def test_compress_by_threshold_twostage_superset_of_exact(rng):
+    """twostage tau is the k-th largest CANDIDATE magnitude <= the exact
+    tau, so its keep mask contains the ENTIRE exact top-k — the property
+    behind the audited recall floor of 1.0 at p=1."""
+    from gtopkssgd_tpu.ops import topk_abs
+
+    n = 100_000
+    acc = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    comp = TopKCompressor(density=0.001, method="twostage")
+    keep, res, _ = comp.compress_by_threshold(acc)
+    _, exact_idx = topk_abs(acc, comp.k(n))
+    assert np.asarray(keep)[np.asarray(exact_idx)].all()
+    np.testing.assert_array_equal(
+        np.where(np.asarray(keep), 0.0, np.asarray(acc)), np.asarray(res))
