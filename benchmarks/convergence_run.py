@@ -100,10 +100,17 @@ def run_mode(args, mode: str, density: float, max_epochs: int,
             # arms on the CPU mesh silently test exact selection —
             # ops/topk.py::simrecall_topk_abs).
             extra["topk_method"] = tag
+        elif tag in ("int8wire", "fp8wire"):
+            # Wire-codec A/B arms: "gtopk+int8wire" runs the identical
+            # schedule with the quantized on-wire codec so the verdict
+            # can pin the final-loss delta of codec error (which folds
+            # into the error-feedback residual) against the fp32 wire.
+            extra["wire_codec"] = tag[:-4]
         else:
             raise SystemExit(f"unknown arm suffix {tag!r} in {mode!r} "
                              "(know: warmup, corr, exact, approx, "
-                             "blockwise, pallas, simrecall)")
+                             "blockwise, pallas, simrecall, int8wire, "
+                             "fp8wire)")
     density = 1.0 if base_mode in ("dense", "none") else density
     cfg = TrainConfig(
         dnn=args.dnn,

@@ -148,6 +148,99 @@ def run_twostage_smoke(out_dir: str) -> dict:
     return rec
 
 
+def run_codec_smoke(out_dir: str) -> dict:
+    """int8-vs-fp32 wire-codec A/B (the ISSUE-7 tentpole's consumer):
+    four tiny flat-gtopk sub-runs — codec x density over {fp32, int8} x
+    {0.001, 0.01} — differing ONLY in those two fields, each with the
+    recall audit on. Returns the fields the main run logs as ONE "codec"
+    record so the drift gate can pin the PR's acceptance numbers:
+
+      wire_ratio_rho001        int8/fp32 measured wire_bytes at rho=1e-3
+                               (the DCN regime k): ~0.32, i.e. >=3x
+      dcn_excess_rho001        max(0, ratio - 1/3): one-sided ">=3x
+                               reduction" evidence, exactly 0.0
+      wire_excess_rho01        max(0, ratio@rho=0.01 - 0.30): the gate
+                               smoke's own density meets the same bar
+      audit_recall_int8        audited recall under the lossy codec
+                               (flat gtopk reselects the exact top-k of
+                               the dequantized merge, so the floor is
+                               ~1.0 — well above the 0.95 acceptance)
+      residual_norm_int8       error feedback stays bounded with the
+                               quantization error folded in
+      ledger_bytes_ratio_int8  obs/ledger.py's modeled-vs-measured wire
+                               bytes on the int8 sub-run: ~1.0 means the
+                               codec-aware model explains the achieved
+                               bytes (the "ledger-audited" acceptance)
+
+    The ratios divide two structurally deterministic counters (byte
+    counts are fixed by k, n and the codec bit budget), so tolerances in
+    the baseline are tight; the one-sided excess fields are exact."""
+    from gtopkssgd_tpu.obs import ledger, report
+    from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+    measured: dict = {}
+    int8_records = None
+    for rho in (0.001, 0.01):
+        for codec in ("fp32", "int8"):
+            sub = os.path.join(
+                out_dir, f"codec_ab_{codec}_rho{rho:g}".replace(".", "p"))
+            cfg = TrainConfig(
+                dnn="resnet20", batch_size=4, nworkers=2,
+                compression="gtopk", density=rho, seed=42,
+                max_epochs=1, log_interval=2, eval_batches=1,
+                obs_interval=1, obs_audit_interval=2,
+                wire_codec=codec, out_dir=sub)
+            with Trainer(cfg) as t:
+                t.train(2)  # audit fires at step 2 (obs_audit_interval)
+            recs, _ = report.load_records(sub)
+            obs = [r for r in recs if r.get("kind") == "obs"]
+            wire = [float(r["wire_bytes"]) for r in obs
+                    if isinstance(r.get("wire_bytes"), (int, float))]
+            audited = [float(r["audit_recall"]) for r in obs
+                       if float(r.get("audit_recall", -1.0)) >= 0.0]
+            res = [float(r["residual_norm"]) for r in obs
+                   if isinstance(r.get("residual_norm"), (int, float))]
+            measured[(codec, rho)] = {
+                "wire_bytes": sum(wire) / len(wire) if wire else 0.0,
+                "audit_recall": max(audited) if audited else -1.0,
+                "residual_norm": res[-1] if res else -1.0,
+            }
+            if codec == "int8" and rho == 0.001:
+                int8_records = recs
+    r001 = (measured[("int8", 0.001)]["wire_bytes"]
+            / max(measured[("fp32", 0.001)]["wire_bytes"], 1e-9))
+    r01 = (measured[("int8", 0.01)]["wire_bytes"]
+           / max(measured[("fp32", 0.01)]["wire_bytes"], 1e-9))
+    rec = {
+        "wire_codec": "int8",
+        "wire_bytes_fp32_rho001": measured[("fp32", 0.001)]["wire_bytes"],
+        "wire_bytes_int8_rho001": measured[("int8", 0.001)]["wire_bytes"],
+        "wire_bytes_fp32_rho01": measured[("fp32", 0.01)]["wire_bytes"],
+        "wire_bytes_int8_rho01": measured[("int8", 0.01)]["wire_bytes"],
+        "wire_ratio_rho001": round(r001, 6),
+        "wire_ratio_rho01": round(r01, 6),
+        "dcn_excess_rho001": round(max(0.0, r001 - 1.0 / 3.0), 6),
+        "wire_excess_rho01": round(max(0.0, r01 - 0.30), 6),
+        "dcn_reduction_x": round(1.0 / max(r001, 1e-9), 4),
+        "audit_recall_int8": measured[("int8", 0.001)]["audit_recall"],
+        "recall_floor_breach": round(max(
+            0.0, 0.95 - measured[("int8", 0.001)]["audit_recall"]), 6),
+        "residual_norm_int8": measured[("int8", 0.001)]["residual_norm"],
+    }
+    # The ledger audit: join the int8 sub-run's achieved wire_bytes
+    # against the codec-aware comm model (obs/ledger.py reads wire_codec
+    # from the manifest). Mean ratio ~1.0 IS the acceptance evidence
+    # that the measured reduction matches the modeled one.
+    rows = [r for r in ledger.ledger_rows(int8_records or [])
+            if r.get("source") == "wire_bytes"
+            and isinstance(r.get("ratio"), (int, float))]
+    if rows:
+        rec["ledger_bytes_ratio_int8"] = round(
+            sum(float(r["ratio"]) for r in rows) / len(rows), 6)
+        rec["ledger_rows_int8"] = len(rows)
+    return rec
+
+
 def run_smoke(out_dir: str) -> str:
     """Train the canonical run; returns the run dir (metrics.jsonl inside).
 
@@ -165,7 +258,8 @@ def run_smoke(out_dir: str) -> str:
     resilience path — injected NaN claimed by a skip policy — and its
     inject/recovery records are grafted into this run's stream, so the
     baseline also pins recovery structure (one firing, one recovery,
-    final_status=completed)."""
+    final_status=completed). The twostage and codec A/B sub-runs graft
+    one summary record each the same way ("twostage", "codec")."""
     from gtopkssgd_tpu.obs import fleet, report
     from gtopkssgd_tpu.obs.trace_attr import attribute, capture
     from gtopkssgd_tpu.trainer import Trainer
@@ -178,6 +272,7 @@ def run_smoke(out_dir: str) -> str:
     # summary record enters this run's stream.
     rec_dir = run_recovery_smoke(out_dir)
     twostage_rec = run_twostage_smoke(out_dir)
+    codec_rec = run_codec_smoke(out_dir)
 
     cfg = smoke_config(out_dir)
     with Trainer(cfg) as t:
@@ -212,6 +307,11 @@ def run_smoke(out_dir: str) -> str:
         # Same graft for the twostage A/B evidence: the gate pins the
         # audited recall floor and the one-sided T_select regression.
         t.metrics.log("twostage", **twostage_rec)
+        # And the wire-codec A/B: int8-vs-fp32 wire-bytes ratios, the
+        # one-sided >=3x DCN-reduction evidence, the audited recall
+        # floor under the lossy codec, and the ledger's modeled-vs-
+        # measured bytes ratio.
+        t.metrics.log("codec", **codec_rec)
     return out_dir
 
 

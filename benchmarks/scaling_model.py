@@ -52,6 +52,7 @@ if _REPO not in sys.path:
 # fold/unfold around the 2^m block) — imported from the collectives so
 # model and implementation cannot drift.
 from gtopkssgd_tpu.parallel import tree_rounds as _tree_rounds  # noqa: E402
+from gtopkssgd_tpu.parallel import get_codec as _get_codec  # noqa: E402
 
 
 def _ring_allreduce_bytes(n_bytes: int, p: int) -> float:
@@ -62,7 +63,8 @@ def _ring_allreduce_bytes(n_bytes: int, p: int) -> float:
 
 def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
             overhead_ms: float, ici_gbps: float, dcn_gbps: float,
-            ici_size: int, batch: int, dcn_alpha_ms: float = 0.0) -> dict:
+            ici_size: int, batch: int, dcn_alpha_ms: float = 0.0,
+            codec: str = "fp32") -> dict:
     """Projected step time at P devices for one reduction mode.
 
     Comm cost = messages x per-message latency + bytes / link-bandwidth
@@ -90,12 +92,13 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
     """
     comm_ms = predict(mode, p, n=n, k=k, ici_gbps=ici_gbps,
                       dcn_gbps=dcn_gbps, ici_size=ici_size,
-                      dcn_alpha_ms=dcn_alpha_ms)
+                      dcn_alpha_ms=dcn_alpha_ms, codec=codec)
     extra = 0.0 if mode == "dense" else overhead_ms
     step_ms = compute_ms + extra + comm_ms
     return {
         "mode": mode,
         "p": p,
+        "codec": codec,
         "comm_ms": round(comm_ms, 3),
         "step_ms": round(step_ms, 3),
         "images_per_sec_per_chip": round(batch / step_ms * 1e3, 1),
@@ -104,18 +107,26 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
 
 def predict(mode: str, p: int, *, n: int, k: int, ici_gbps: float,
             dcn_gbps: float, ici_size: int,
-            dcn_alpha_ms: float = 0.0) -> float:
+            dcn_alpha_ms: float = 0.0, codec: str = "fp32") -> float:
     """Predicted comm_ms alone — the comm-model ledger's entry point
     (obs/ledger.py joins this against measured per-step T_comm). Same
     model as project(), with the compute/overhead/throughput bookkeeping
     stripped: the ledger compares communication, the only term the
     alpha-beta model actually predicts. Unrounded (ratio math should not
     inherit display rounding); map gtopk_layerwise to gtopk on the wire
-    exactly as project() documents."""
+    exactly as project() documents.
+
+    ``codec`` sets the per-set sparse payload
+    (parallel.codec.WireCodec.wire_set_bytes — packed values + bf16
+    block scales + Elias-Fano bitpacked indices; fp32 identity = the
+    historical 8 bytes/element). Every sparse exchange — ICI and DCN
+    rounds alike — ships codec bytes, because the tree encodes every
+    round; the hier mode's dense intra-slice psum stays 4n fp32."""
     # The layerwise mode's wire cost IS gtopk's: the layerwise K differs
     # from ceil(rho*N) only by the +1-per-tiny-leaf ceil rounding (<1%
     # for ResNet-50 at rho=1e-3).
     wire_mode = "gtopk" if mode == "gtopk_layerwise" else mode
+    set_bytes = _get_codec(codec).wire_set_bytes(k, n)
     ici_Bps = ici_gbps * 1e9 / 8
     dcn_Bps = dcn_gbps * 1e9 / 8
     s = min(ici_size, p)
@@ -151,16 +162,17 @@ def predict(mode: str, p: int, *, n: int, k: int, ici_gbps: float,
             # floor(log2) is the intended count for ragged s too.
             ici_rounds = min(m, s).bit_length() - 1
             flat_dcn_rounds = total_rounds - ici_rounds
-        return (ici_rounds * (8 * k) / ici_Bps * 1e3
-                + flat_dcn_rounds * ((8 * k) / dcn_Bps * 1e3
+        return (ici_rounds * set_bytes / ici_Bps * 1e3
+                + flat_dcn_rounds * (set_bytes / dcn_Bps * 1e3
                                      + dcn_alpha_ms))
     if wire_mode == "allgather":
-        return ((8 * k * s) / ici_Bps * 1e3
-                + (8 * k * (p - s)) / dcn_Bps * 1e3
+        return ((set_bytes * s) / ici_Bps * 1e3
+                + (set_bytes * (p - s)) / dcn_Bps * 1e3
                 + (n_slices - 1) * dcn_alpha_ms)
     if wire_mode == "gtopk_hier":
         return (_ring_allreduce_bytes(4 * n, s) / ici_Bps * 1e3
-                + dcn_rounds * ((8 * k) / dcn_Bps * 1e3 + dcn_alpha_ms))
+                + dcn_rounds * (set_bytes / dcn_Bps * 1e3
+                                + dcn_alpha_ms))
     raise ValueError(mode)
 
 
@@ -187,6 +199,9 @@ def main():
     ap.add_argument("--dcn-alpha-ms", type=float, default=0.0,
                     help="fitted per-message DCN latency (dcn_probe.py "
                          "alpha_beta_fit.alpha_ms); 0 = bandwidth-only")
+    ap.add_argument("--wire-codec", default="fp32",
+                    help="sparse payload codec (parallel.codec grammar: "
+                         "fp32 | int8[:BLOCK] | fp8[:BLOCK])")
     ap.add_argument("--ps", type=int, nargs="+",
                     default=[1, 4, 16, 32, 64, 256])
     args = ap.parse_args()
@@ -195,7 +210,8 @@ def main():
     kw = dict(n=args.n, k=k, compute_ms=args.compute_ms,
               overhead_ms=args.overhead_ms, ici_gbps=args.ici_gbps,
               dcn_gbps=args.dcn_gbps, ici_size=args.ici_size,
-              batch=args.batch, dcn_alpha_ms=args.dcn_alpha_ms)
+              batch=args.batch, dcn_alpha_ms=args.dcn_alpha_ms,
+              codec=args.wire_codec)
     print(json.dumps({"model": ("latency+bandwidth projection (see "
                                 "docstring; alpha=0 => bandwidth-only)"),
                       "k": k, **{a: getattr(args, a)

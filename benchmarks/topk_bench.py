@@ -30,10 +30,12 @@ JAX_PLATFORMS, so the config API is the only reliable override), runs the
 quick sweep with the Pallas kernels in interpret mode, tags the artifact
 `"backend": "cpu_fallback"`, and appends the one-pass counting evidence
 (largest compiled op is 1xN for the fused/bucketize counting pass vs 8xN
-for the vmapped 8-reduction it replaced) so BENCH rounds carry fresh,
-comparable selection data even with no chip attached. Interpret-mode ms
-are NOT device numbers — recall columns and op-size assertions are the
-meaningful fields there.
+for the vmapped 8-reduction it replaced) plus wire-codec microbench rows
+(`codec_rows`: bytes/elem, roundtrip error, recall-after-quantization vs
+exact for fp32/int8/fp8 — parallel/codec.py) so BENCH rounds carry
+fresh, comparable selection data even with no chip attached.
+Interpret-mode ms are NOT device numbers — recall columns, codec byte
+ratios and op-size assertions are the meaningful fields there.
 
 Run:  python -m benchmarks.topk_bench [--out PATH] [--quick] [--cpu-fallback]
 """
@@ -171,6 +173,74 @@ def one_pass_evidence(n: int) -> dict:
     }
 
 
+def codec_rows(n: int, min_seconds: float = 0.3) -> list:
+    """Wire-codec encode/decode microbench: bytes/elem on the wire,
+    encode->decode roundtrip value error, and selection recall AFTER
+    quantization (top-2k candidates requantized, top-k reselected from
+    the dequantized magnitudes, recalled against the exact top-k — the
+    merge-then-reselect operation every tree round performs on decoded
+    values). fp32 rows pin the identity: 8 bytes/elem, zero error,
+    recall 1."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from gtopkssgd_tpu.ops.topk import k_for_density, topk_abs
+    from gtopkssgd_tpu.parallel import get_codec, roundtrip_aligned
+    from gtopkssgd_tpu.utils import (
+        sync_round_trip_seconds,
+        timed_window,
+        true_sync,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
+    rows = []
+    for rho in DENSITIES:
+        k = k_for_density(n, rho)
+        ev, ei = topk_abs(x, k)
+        exact_idx = set(np.asarray(ei).tolist())
+        cv, ci = topk_abs(x, 2 * k)
+        for name in ("fp32", "int8", "fp8"):
+            c = get_codec(name)
+            fn = jax.jit(lambda v, i: c.decode(
+                c.encode(v, i, n=n), k=k, n=n))
+            out = fn(ev, ei)
+            rtt = sync_round_trip_seconds(out)
+
+            def chunk(reps):
+                o = out
+                for _ in range(reps):
+                    o = fn(ev, ei)
+                true_sync(o)
+
+            sec, steps = timed_window(chunk, rtt, min_seconds, 4)
+            vq = np.asarray(roundtrip_aligned(c, ev, ei, n=n))
+            evn = np.asarray(ev)
+            rel_err = float(np.linalg.norm(vq - evn)
+                            / max(np.linalg.norm(evn), 1e-12))
+            # recall after quantization: reselect k of 2k candidates
+            # from dequantized magnitudes
+            cq = np.asarray(roundtrip_aligned(c, cv, ci, n=n))
+            keep = np.argsort(-np.abs(cq), kind="stable")[:k]
+            requant_idx = set(np.asarray(ci)[keep].tolist())
+            recall = len(requant_idx & exact_idx) / k
+            rows.append({
+                "n": n, "density": rho, "k": k, "codec": c.name,
+                "bytes_per_elem": round(c.wire_set_bytes(k, n) / k, 3),
+                "wire_ratio_vs_fp32": round(
+                    c.wire_set_bytes(k, n) / (8 * k), 4),
+                "roundtrip_rel_err": round(rel_err, 6),
+                "recall_after_quantization": round(recall, 4),
+                "roundtrip_ms": round(sec * 1e3, 4),
+                "steps_timed": steps,
+            })
+            print(f"codec {c.name:8s} rho={rho:<6g} "
+                  f"{rows[-1]['bytes_per_elem']:6.2f} B/elem "
+                  f"err={rel_err:.5f} recall={recall:.4f}", flush=True)
+    return rows
+
+
 def run_sweep(quick: bool, min_seconds: float, interpret: bool,
               with_recall: bool = True):
     from gtopkssgd_tpu.ops.topk import k_for_density
@@ -248,6 +318,11 @@ def main(argv=None):
     if args.cpu_fallback:
         result["one_pass_evidence"] = one_pass_evidence(
             list(SIZES.values())[0])
+        # Wire-codec evidence rides the same artifact: bytes/elem,
+        # roundtrip error and recall-after-quantization are
+        # backend-independent (deterministic packing), so the dead-tunnel
+        # artifact still carries fresh codec numbers.
+        result["codec_rows"] = codec_rows(list(SIZES.values())[0])
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

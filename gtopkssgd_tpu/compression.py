@@ -176,6 +176,24 @@ class TopKCompressor:
         put_back = jnp.where(rejected, local_vals, 0.0)
         return residual.at[local_idx].add(put_back, mode="drop")
 
+    def fold_wire_error(
+        self,
+        residual: Array,
+        local_idx: Array,
+        wire_err: Array,
+    ) -> Array:
+        """Fold wire-codec quantization error into the residual.
+
+        ``wire_err = vals - dequant(quant(vals))`` per selected slot
+        (parallel.codec.roundtrip_aligned keeps original slot order, so
+        it lines up with ``local_idx``). Called BEFORE the collective:
+        the shipped values become the requantized ones, the error stays
+        local, and the ``repair`` above — which restores the SHIPPED
+        value for rejected picks — then composes exactly: requantized
+        value + folded error = the original selection. Sentinel slots
+        (idx == n) carry zero error and drop out of the scatter."""
+        return residual.at[local_idx].add(wire_err, mode="drop")
+
 
 @dataclasses.dataclass(frozen=True)
 class NoneCompressor:

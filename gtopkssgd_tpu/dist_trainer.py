@@ -17,6 +17,22 @@ becomes
     python -m gtopkssgd_tpu.dist_trainer --dnn resnet20 --density 0.001 \
         --nworkers 8
 
+Wire-format flag (parallel.codec — no reference equivalent; the MPI
+reference always shipped fp32 values + int32 indices):
+
+    --wire-codec CODEC                   on-wire sparse-set encoding for
+                                         every exchange round. Grammar:
+                                         fp32 (identity, default) |
+                                         int8[:BLOCK] | fp8[:BLOCK] —
+                                         block-scaled 8-bit values (bf16
+                                         scales, BLOCK defaults to 64)
+                                         + Elias-Fano bitpacked indices;
+                                         quantization error folds into
+                                         the error-feedback residual.
+                                         Recorded in the run manifest;
+                                         audit measured-vs-modeled bytes
+                                         with ``report ledger``
+
 Observability flags (obs subsystem — no reference equivalent; the
 reference's only telemetry was text logs):
 
@@ -133,6 +149,13 @@ def build_argparser() -> argparse.ArgumentParser:
                    choices=["auto", "exact", "blockwise", "approx",
                             "threshold", "pallas", "twostage",
                             "simrecall"])
+    p.add_argument("--wire-codec", default="fp32",
+                   help="on-wire sparse-set codec for every exchange "
+                        "round: fp32 (identity), int8[:BLOCK] or "
+                        "fp8[:BLOCK] (block-scaled values, bf16 scales, "
+                        "Elias-Fano bitpacked indices; BLOCK defaults "
+                        "to 64). Quantization error folds into the "
+                        "error-feedback residual")
     p.add_argument("--clip-grad-norm", type=float, default=None)
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="optimizer steps per jitted dispatch (lax.scan "
@@ -287,6 +310,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         density=args.density,
         hier_ici=args.hier_ici,
         topk_method=args.topk_method,
+        wire_codec=args.wire_codec,
         clip_grad_norm=args.clip_grad_norm,
         nsteps_update=args.nsteps_update,
         steps_per_dispatch=args.steps_per_dispatch,

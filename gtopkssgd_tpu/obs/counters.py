@@ -128,6 +128,7 @@ def make_telemetry(
     p: int,
     mode,
     ici_size: int = 1,
+    codec="fp32",
     grad_norm_pre,
     grad_norm_post,
     residual_norm,
@@ -137,11 +138,13 @@ def make_telemetry(
 ) -> Dict[str, Array]:
     """Assemble the per-step telemetry dict (all f32 scalars).
 
-    ``n``/``k``/``p``/``mode``/``ici_size`` are static trace-time values;
-    ``wire_bytes`` therefore folds to a constant — the model volume for
-    this step's collective from the one shared definition
-    (parallel.comm_bytes_per_step), so the metric can never drift from
-    the benchmark's comm model."""
+    ``n``/``k``/``p``/``mode``/``ici_size``/``codec`` are static
+    trace-time values; ``wire_bytes`` therefore folds to a constant — the
+    model volume for this step's collective from the one shared
+    definition (parallel.comm_bytes_per_step), so the metric can never
+    drift from the benchmark's comm model. With a quantized wire codec
+    the constant is CODEC bytes (packed values + scales + bitpacked
+    indices), not logical fp32 bytes."""
     sent = jnp.asarray(sent_elems, jnp.float32)
     return {
         "grad_norm_pre": jnp.asarray(grad_norm_pre, jnp.float32),
@@ -151,7 +154,8 @@ def make_telemetry(
         "sent_elems": sent,
         "achieved_density": sent / jnp.float32(max(1, n)),
         "wire_bytes": jnp.float32(
-            comm_bytes_per_step(mode, n, k, p, ici_size=ici_size)
+            comm_bytes_per_step(mode, n, k, p, ici_size=ici_size,
+                                codec=codec)
         ),
         "m_k": jnp.asarray(m_k, jnp.float32),
     }

@@ -69,21 +69,36 @@ def _tree_rounds_fallback(p: int) -> int:
     return (m.bit_length() - 1) + (0 if m == p else 2)
 
 
+def _codec_set_bytes(codec: str, k: int, n: int) -> int:
+    """On-wire bytes of one encoded k-of-n sparse set under `codec` —
+    the one shared definition (parallel.codec.WireCodec.wire_set_bytes)
+    when the package is importable, else the fp32 identity (8 bytes per
+    element), so a bare-ledger install still reconciles uncompressed
+    runs."""
+    try:
+        from gtopkssgd_tpu.parallel.codec import get_codec
+        return get_codec(codec).wire_set_bytes(k, n)
+    except Exception:
+        return 8 * k
+
+
 def predict_comm_ms(mode: str, p: int, *, n: int, k: int,
                     alpha_ms: float = 0.0,
                     beta_gbps: float = DEFAULT_DCN_GBPS,
                     ici_gbps: float = DEFAULT_ICI_GBPS,
-                    ici_size: int = 1) -> float:
+                    ici_size: int = 1,
+                    codec: str = "fp32") -> float:
     """Predicted comm_ms via scaling_model.predict when benchmarks/ is
     importable, else a pure alpha-beta tree model (rounds x alpha +
     bytes/beta on the slow link) — the degenerate ici_size=1 case of the
     full model, which is exactly the multi-process CPU/DCN topology the
-    ledger's tests and typical --multihost runs live on."""
+    ledger's tests and typical --multihost runs live on. ``codec`` sets
+    the per-round sparse payload size (parallel.codec wire bytes)."""
     sm = _load_scaling_model()
     if sm is not None and hasattr(sm, "predict"):
         return sm.predict(mode, p, n=n, k=k, ici_gbps=ici_gbps,
                           dcn_gbps=beta_gbps, ici_size=ici_size,
-                          dcn_alpha_ms=alpha_ms)
+                          dcn_alpha_ms=alpha_ms, codec=codec)
     beta_Bps = beta_gbps * 1e9 / 8
     wire_mode = "gtopk" if mode == "gtopk_layerwise" else mode
     if wire_mode == "dense":
@@ -91,13 +106,14 @@ def predict_comm_ms(mode: str, p: int, *, n: int, k: int,
         return (bytes_per_dev / beta_Bps * 1e3
                 + 2 * (p - 1) * alpha_ms)
     rounds = _tree_rounds_fallback(p)
+    set_bytes = _codec_set_bytes(codec, k, n)
     if wire_mode == "gtopk":
-        return rounds * ((8 * k) / beta_Bps * 1e3 + alpha_ms)
+        return rounds * (set_bytes / beta_Bps * 1e3 + alpha_ms)
     if wire_mode == "allgather":
-        return ((8 * k * (p - 1)) / beta_Bps * 1e3
+        return (set_bytes * (p - 1) / beta_Bps * 1e3
                 + (p - 1) * alpha_ms)
     if wire_mode == "gtopk_hier":
-        return rounds * ((8 * k) / beta_Bps * 1e3 + alpha_ms)
+        return rounds * (set_bytes / beta_Bps * 1e3 + alpha_ms)
     raise ValueError(mode)
 
 
@@ -148,7 +164,9 @@ def _manifest_params(manifest: Optional[Mapping[str, Any]]
          if isinstance(rho, (int, float)) and rho > 0 else n)
     if mode == "dense":
         k = n
-    return {"mode": str(mode), "p": p, "n": n, "k": k}
+    codec = manifest.get("wire_codec")
+    return {"mode": str(mode), "p": p, "n": n, "k": k,
+            "codec": str(codec) if codec else "fp32"}
 
 
 def ledger_rows(records: Sequence[Mapping[str, Any]],
@@ -204,11 +222,11 @@ def ledger_rows(records: Sequence[Mapping[str, Any]],
     predicted_ms = predict_comm_ms(
         params["mode"], params["p"], n=params["n"], k=params["k"],
         alpha_ms=alpha_ms, beta_gbps=beta_gbps, ici_gbps=ici_gbps,
-        ici_size=ici_size)
+        ici_size=ici_size, codec=params["codec"])
 
     base = {
         "mode": params["mode"], "p": params["p"],
-        "n": params["n"], "k": params["k"],
+        "n": params["n"], "k": params["k"], "codec": params["codec"],
         "alpha_ms": round(alpha_ms, 6), "beta_gbps": round(beta_gbps, 6),
         "ici_size": ici_size, "fit_source": fit_source,
         "predicted_comm_ms": round(predicted_ms, 6),
@@ -237,19 +255,22 @@ def ledger_rows(records: Sequence[Mapping[str, Any]],
             if not isinstance(wire, (int, float)) or wire <= 0:
                 continue
             # Bytes-side sanity row: achieved wire bytes vs the model's
-            # per-device volume (8k per sparse round; dense ring 2(p-1)/p
-            # x 4n). No timing — the ratio checks volume accounting, the
-            # attr rows check time.
+            # per-device volume (codec set bytes per sparse round — 8k
+            # under the fp32 identity; dense ring 2(p-1)/p x 4n). No
+            # timing — the ratio checks volume accounting, the attr rows
+            # check time.
             p, nn, k = params["p"], params["n"], params["k"]
             wm = ("gtopk" if params["mode"] == "gtopk_layerwise"
                   else params["mode"])
+            set_bytes = _codec_set_bytes(params["codec"], k, nn)
             if wm == "dense":
                 pred_bytes = 2.0 * (p - 1) / p * 4 * nn if p > 1 else 0.0
             elif wm in ("gtopk", "gtopk_hier"):
                 pred_bytes = _tree_rounds_fallback(
-                    p if wm == "gtopk" else max(1, p // ici_size)) * 8 * k
+                    p if wm == "gtopk"
+                    else max(1, p // ici_size)) * set_bytes
             elif wm == "allgather":
-                pred_bytes = 8 * k * (p - 1)
+                pred_bytes = set_bytes * (p - 1)
             else:
                 pred_bytes = 0.0
             rows.append({

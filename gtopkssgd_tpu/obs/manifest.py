@@ -30,6 +30,7 @@ _HEADLINE_KEYS = (
     "dataset",
     "compression",
     "density",
+    "wire_codec",
     "nworkers",
     "batch_size",
     "seed",

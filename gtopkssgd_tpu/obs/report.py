@@ -858,10 +858,30 @@ def run_ledger(targets: Sequence[str], json_out: Optional[str] = None,
         return 1
     base = rows[0]
     print(f"ledger: mode={base['mode']} p={base['p']} n={base['n']} "
-          f"k={base['k']}  alpha_ms={base['alpha_ms']} "
+          f"k={base['k']} codec={base.get('codec', 'fp32')}  "
+          f"alpha_ms={base['alpha_ms']} "
           f"beta_gbps={base['beta_gbps']} ici_size={base['ici_size']} "
           f"(fit: {base['fit_source']})")
     print(f"predicted comm: {_fmt(base['predicted_comm_ms'])} ms/step")
+    # Codec-bytes audit: modeled vs measured wire bytes per rank (the
+    # wire_bytes rows carry both sides of the join).
+    wire_rows = [r for r in rows if r.get("source") == "wire_bytes"
+                 and isinstance(r.get("predicted_wire_bytes"),
+                                (int, float))]
+    if wire_rows:
+        by_rank = {}
+        for r in wire_rows:
+            by_rank.setdefault(r.get("rank", 0), []).append(r)
+        parts = []
+        for rk in sorted(by_rank):
+            rws = by_rank[rk]
+            meas = sum(float(r["measured_wire_bytes"])
+                       for r in rws) / len(rws)
+            pred = float(rws[0]["predicted_wire_bytes"])
+            parts.append(f"r{rk}: {_fmt(pred)}B model / "
+                         f"{_fmt(meas)}B measured")
+        print(f"codec bytes ({base.get('codec', 'fp32')}): "
+              + "  ".join(parts))
     summary = ledger.summarize_ledger(rows)
     table = []
     for source in sorted(summary):

@@ -57,7 +57,8 @@ from gtopkssgd_tpu.ops import (
     select_topk,
     topk_abs,
 )
-from gtopkssgd_tpu.parallel import ici_dense_psum, sparse_allreduce
+from gtopkssgd_tpu.parallel import (
+    get_codec, ici_dense_psum, roundtrip_aligned, sparse_allreduce)
 
 Array = jax.Array
 ScalarOrSchedule = Union[float, Callable[[Array], Array]]
@@ -108,6 +109,7 @@ def gtopk_sgd(
     axis_name: Optional[str] = "dp",
     axis_size: Optional[int] = None,
     hier_ici_size: int = 1,
+    wire_codec: str = "fp32",
     warmup_dense_steps: int = 0,
     momentum_correction: bool = False,
     telemetry: bool = False,
@@ -173,6 +175,18 @@ def gtopk_sgd(
     hypercube runs only ACROSS the ``P / hier_ici_size`` slices (the DCN
     hop, where sparsity pays). Every device of a slice computes identical
     sets, so the per-device residual stays consistent automatically.
+
+    ``wire_codec`` (parallel.codec grammar: ``fp32 | int8[:BLOCK] |
+    fp8[:BLOCK]``) selects the on-wire encoding of every sparse exchange.
+    With a lossy codec the shipped values are requantized BEFORE the
+    collective (``roundtrip_aligned``) and the quantization error
+    ``vals - dequant(quant(vals))`` folds into the error-feedback
+    residual right here at the compression layer, so codec error is
+    self-correcting exactly like selection error; the collective then
+    transports bits that decode to precisely the values selection was
+    told were sent. Intermediate merge rounds requantize partial sums —
+    that second-order error is shared bitwise-identically by all ranks
+    (codec determinism) and is NOT residual-fed.
 
     ``momentum_correction`` (TPU extension, DGC arXiv:1712.01887 §3.1-3.2
     — not reference parity: the reference runs torch momentum-SGD on the
@@ -297,6 +311,9 @@ def gtopk_sgd(
             "out a semantics fix) — prefer one or the other",
             stacklevel=2)
     compressor = get_compressor(mode, density=density, method=topk_method)
+    # Validate the codec spec at build time (bad --wire-codec fails here,
+    # not inside the jitted step); the instance is reused every step.
+    codec = get_codec(wire_codec)
     inner = optax.chain(
         optax.add_decayed_weights(weight_decay) if weight_decay else optax.identity(),
         # With momentum correction the velocity lives BEFORE the collective
@@ -491,9 +508,25 @@ def gtopk_sgd(
                 (i + o).astype(jnp.int32)
                 for i, o in zip(idx_l, offsets)
             ])
+            if codec.lossy:
+                # Wire-error fold, layerwise twin: requantize the
+                # concatenated set, ship vq, and scatter the error back
+                # into each leaf's residual with the same static
+                # [pos:pos+k_l] slices the repair uses — the error is in
+                # concatenation order because roundtrip_aligned returns
+                # original slot order.
+                vq = roundtrip_aligned(codec, vals, idx, n=n)
+                err = vals - vq
+                folded, pos = [], 0
+                for r, i, kl in zip(new_res, idx_l, ks):
+                    folded.append(
+                        r.at[i].add(err[pos:pos + kl], mode="drop"))
+                    pos += kl
+                new_res = folded
+                vals = vq
             gvals, gidx, _ = sparse_allreduce(
                 mode, vals, idx, k=kk_total, n=n,
-                axis_name=axis_name, axis_size=p,
+                axis_name=axis_name, axis_size=p, codec=codec,
             )
             # Error-feedback repair, split back per leaf: put_back's layout
             # IS the concatenation order, so static [pos:pos+k_l] slices
@@ -584,7 +617,7 @@ def gtopk_sgd(
         updates, inner_state = inner.update(avg_grads, state.inner, params)
         if telemetry:
             tel = obs_counters.make_telemetry(
-                n=n, k=kk_total, p=p, mode=mode,
+                n=n, k=kk_total, p=p, mode=mode, codec=codec,
                 grad_norm_pre=obs_counters.tree_l2(flats),
                 grad_norm_post=obs_counters.tree_l2(dense_fl),
                 residual_norm=obs_counters.tree_l2(res_struct),
@@ -740,6 +773,23 @@ def gtopk_sgd(
                 else:
                     vals, idx, residual = compressor.compress(
                         acc, grad=src, residual=residual_in)
+                    if codec.lossy and mode != "topk":
+                        # Fold the wire quantization error into the
+                        # error-feedback residual and ship the
+                        # requantized values: the residual repair below
+                        # then restores vq + folded error = the exact
+                        # original for rejected picks, and telemetry
+                        # (tau/sent/mass) describes what actually went on
+                        # the wire. (mode 'topk' allgathers the exact
+                        # local picks — its codec path quantizes in
+                        # topk_allgather and every pick is delivered, so
+                        # there is nothing to repair and the small
+                        # symmetric error is left to the next step's
+                        # selection, like any dense rounding.)
+                        vq = roundtrip_aligned(codec, vals, idx, n=n)
+                        residual = compressor.fold_wire_error(
+                            residual, idx, vals - vq)
+                        vals = vq
                     if telemetry:
                         # Selection stats describe the LOCAL selection
                         # (what this device put on the wire); the pmean
@@ -770,6 +820,7 @@ def gtopk_sgd(
                         mode, vals, idx, k=compressor.k(n), n=n,
                         axis_name=axis_name, axis_size=p,
                         ici_size=hier_ici_size if hier else 1,
+                        codec=codec,
                     )
                     if needs_repair:  # gtopk: sparse set + repair
                         residual = compressor.repair(
@@ -845,6 +896,7 @@ def gtopk_sgd(
             tel = obs_counters.make_telemetry(
                 n=n, k=(n if dense_mode else compressor.k(n)), p=p,
                 mode=mode, ici_size=hier_ici_size if hier else 1,
+                codec=codec,
                 grad_norm_pre=obs_counters.tree_l2(flat),
                 grad_norm_post=obs_counters.tree_l2(dense),
                 residual_norm=obs_counters.tree_l2(res_struct),

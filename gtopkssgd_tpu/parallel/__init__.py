@@ -8,6 +8,12 @@ exchanges ride ICI for the gTop-k tree, `all_gather` implements the DGC
 baseline, `psum` the dense baseline. No threads, no host staging, no D2H/H2D.
 """
 
+from gtopkssgd_tpu.parallel.codec import (
+    CODEC_NAMES,
+    WireCodec,
+    get_codec,
+    roundtrip_aligned,
+)
 from gtopkssgd_tpu.parallel.collectives import (
     dense_allreduce,
     gtopk_allreduce,
@@ -21,6 +27,10 @@ from gtopkssgd_tpu.parallel.collectives import (
 from gtopkssgd_tpu.parallel.mesh import make_mesh, dp_axis
 
 __all__ = [
+    "CODEC_NAMES",
+    "WireCodec",
+    "get_codec",
+    "roundtrip_aligned",
     "dense_allreduce",
     "gtopk_allreduce",
     "hier_gtopk_allreduce",

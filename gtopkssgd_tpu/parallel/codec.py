@@ -1,0 +1,393 @@
+"""Pluggable wire codecs for the sparse (vals, idx) exchange set.
+
+Every sparse collective in this package ships the same payload: a fixed-k
+set of (f32 value, i32 index) pairs with sentinel ``idx == n`` padding.
+The hypercube merge exchanges that set once per tree round over DCN, so
+its on-wire size IS the gTop-k byte bill (ROADMAP item 2). This module
+turns the payload into a pluggable codec:
+
+  fp32          identity — (vals, idx) ship as-is, 8 bytes/element.
+                Bit-exact with the pre-codec wire (the default).
+  int8[:B]      per-block symmetric int8 value quantization (EQuARX
+                lineage, arXiv:2506.17615): blocks of B values share one
+                max-|v|/127 scale shipped as bf16; indices are
+                sort + delta + bitpack coded (below).
+  fp8[:B]       same framing with float8_e4m3fn values (max-|v|/448
+                block scales) — more dynamic range per element at the
+                same 8 bits.
+
+Index coding (quantized codecs): the set is sorted by index (the merge
+is order-canonical, so reordering is free), and each index splits into
+``l = floor(log2((n+1)/k))`` low bits, bitpacked at exactly l bits per
+element, plus a high part whose sorted DELTAS are unary-coded into a
+monotone bit-vector (the Elias-Fano refinement of delta coding: the
+packed width stays ~log2(n/k) + 2 bits/element instead of the
+ceil(log2 n) a flat delta pack would pay, which is what makes the >=3x
+DCN reduction reachable at rho=0.001 — a flat pack's 19 index bits at
+ResNet-20 scale caps the whole codec at ~2.3x). Everything is padded to
+the 32-bit lane: the wire is ONE uint32 buffer of statically-known
+length, assembled with ``lax.bitcast_convert_type`` dtype punning —
+fixed shapes, jit/ppermute-compatible.
+
+Determinism contract: encode is a pure deterministic function of the
+set, so two hypercube partners that decode the same buffer — or a rank
+that decodes its OWN buffer — recover bit-identical (vals, idx). The
+merge tree exploits this by merging decode(own wire) with decode(partner
+wire): both partners see the same pair of dequantized sets and stay
+bit-identical through every round (collectives._merge_tree docstring).
+
+Error accounting: the first quantization's error (v - dequant(quant(v)))
+is folded into the error-feedback residual at the compression layer
+(``roundtrip_aligned`` + compression.TopKCompressor.fold_wire_error), so
+convergence self-corrects exactly like top-k truncation error does.
+Re-quantization of intermediate merged sums inside the tree is NOT
+residual-fed (both partners requantize identically, so it cancels to a
+shared, second-order perturbation of the merge oracle).
+
+Byte accounting (``wire_set_bytes``) is host-side integer arithmetic on
+the same layout the encoder emits — comm_bytes_per_step, the scaling
+model, and the obs ledger all read it, so modeled and shipped bytes
+cannot drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+_LANE_BITS = 32  # wire lane width: everything pads to whole uint32 words
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Layout:
+    """Static wire layout for one (k, n, block) shape — the single source
+    both the encoder and the byte model read."""
+    k: int
+    n: int
+    block: int
+    l_bits: int        # low bits per index (Elias-Fano split)
+    n_blocks: int      # value scale blocks
+    val_words: int     # packed 8-bit values
+    scale_words: int   # bf16 block scales
+    up_words: int      # monotone high-part bit-vector
+    low_words: int     # bitpacked low index bits
+
+    @property
+    def total_words(self) -> int:
+        return (self.val_words + self.scale_words
+                + self.up_words + self.low_words)
+
+
+def _layout(k: int, n: int, block: int) -> _Layout:
+    if k < 1 or n < 1:
+        raise ValueError(f"codec layout needs k >= 1, n >= 1 (k={k} n={n})")
+    # Index universe is [0, n] — the sentinel n must encode exactly.
+    u = n + 1
+    l_bits = max(0, (u // k).bit_length() - 1) if u > k else 0
+    l_bits = min(l_bits, 31)
+    n_blocks = _ceil_div(k, block)
+    up_bits = (n >> l_bits) + k  # positions high_i + i, strictly increasing
+    return _Layout(
+        k=k, n=n, block=block, l_bits=l_bits, n_blocks=n_blocks,
+        val_words=_ceil_div(k, 4),
+        scale_words=_ceil_div(n_blocks, 2),
+        up_words=_ceil_div(up_bits, _LANE_BITS),
+        low_words=_ceil_div(k * l_bits, _LANE_BITS),
+    )
+
+
+# --------------------------------------------------------------------------
+# Bit plumbing: fixed-width pack/unpack over uint32 lanes. Each element's
+# bits may straddle two words; contributions within a word occupy disjoint
+# bit ranges, so scatter-add is scatter-or.
+# --------------------------------------------------------------------------
+
+
+def _pack_bits(values: Array, width: int, n_words: int) -> Array:
+    """Pack uint32[k] values (< 2^width each) at `width` bits/element."""
+    if n_words == 0 or width == 0:
+        return jnp.zeros((n_words,), jnp.uint32)
+    k = values.shape[0]
+    start = jnp.arange(k, dtype=jnp.int32) * width
+    w = start // _LANE_BITS
+    o = (start % _LANE_BITS).astype(jnp.uint32)
+    low = jnp.left_shift(values, o)
+    spill = (o + width) > _LANE_BITS
+    # o > 0 whenever spill (width <= 32), so the shift stays in [1, 31].
+    sh = jnp.where(o > 0, _LANE_BITS - o, 1).astype(jnp.uint32)
+    high = jnp.where(spill, jnp.right_shift(values, sh), jnp.uint32(0))
+    words = jnp.zeros((n_words,), jnp.uint32)
+    words = words.at[w].add(low, mode="drop")
+    return words.at[w + 1].add(high, mode="drop")
+
+
+def _unpack_bits(words: Array, width: int, k: int) -> Array:
+    """Inverse of _pack_bits -> uint32[k]."""
+    if width == 0:
+        return jnp.zeros((k,), jnp.uint32)
+    start = jnp.arange(k, dtype=jnp.int32) * width
+    w = start // _LANE_BITS
+    o = (start % _LANE_BITS).astype(jnp.uint32)
+    cur = jnp.take(words, w, mode="clip")
+    nxt = jnp.take(words, w + 1, mode="clip")
+    lo = jnp.right_shift(cur, o)
+    spill = (o + width) > _LANE_BITS
+    sh = jnp.where(o > 0, _LANE_BITS - o, 1).astype(jnp.uint32)
+    hi = jnp.where(spill, jnp.left_shift(nxt, sh), jnp.uint32(0))
+    mask = jnp.uint32(0xFFFFFFFF if width >= 32 else (1 << width) - 1)
+    return (lo | hi) & mask
+
+
+def _bytes_to_words(b: Array) -> Array:
+    """uint8[4m] -> uint32[m] via bitcast punning."""
+    return lax.bitcast_convert_type(b.reshape(-1, 4), jnp.uint32)
+
+
+def _words_to_bytes(w: Array) -> Array:
+    """uint32[m] -> uint8[4m]."""
+    return lax.bitcast_convert_type(w, jnp.uint8).reshape(-1)
+
+
+# --------------------------------------------------------------------------
+# Codec descriptors
+# --------------------------------------------------------------------------
+
+
+class WireCodec:
+    """fp32 identity codec: the wire IS (vals, idx), bit-exact with the
+    pre-codec collectives. Also the base class quantized codecs extend."""
+
+    name = "fp32"
+    values_bits = 32
+    scale_bits = 0
+    block = 0
+    lossy = False
+
+    def index_bits(self, k: int, n: int) -> float:
+        return 32.0
+
+    def wire_set_bytes(self, k: int, n: int) -> int:
+        """On-wire bytes of one k-of-n sparse set. fp32 must reproduce the
+        historical 4-byte-value + 4-byte-index formula exactly (test-pinned:
+        the comm model, ledger and baselines all assumed 8k)."""
+        return 8 * k
+
+    def bit_budget(self, k: int, n: int) -> Dict[str, float]:
+        """Per-element bit decomposition for benches/docs."""
+        return {"values_bits": float(self.values_bits),
+                "index_bits": self.index_bits(k, n),
+                "scale_bits": 0.0,
+                "total_bits": float(self.values_bits) + self.index_bits(k, n)}
+
+    def encode(self, vals: Array, idx: Array, *, n: int) -> Tuple[Array, ...]:
+        return (vals, idx)
+
+    def decode(self, wire: Tuple[Array, ...], *, k: int, n: int
+               ) -> Tuple[Array, Array]:
+        return wire[0], wire[1]
+
+    def __repr__(self):
+        return f"WireCodec({self.name!r})"
+
+
+class _QuantCodec(WireCodec):
+    """Shared framing for the 8-bit value codecs (int8 / fp8)."""
+
+    values_bits = 8
+    scale_bits = 16
+    lossy = True
+
+    def __init__(self, block: int):
+        if block < 4 or block % 4:
+            raise ValueError(
+                f"codec block size must be a positive multiple of 4, "
+                f"got {block}")
+        self.block = block
+        self.name = f"{self.base_name}:{block}"
+
+    def index_bits(self, k: int, n: int) -> float:
+        lo = _layout(k, n, self.block)
+        return (lo.up_words + lo.low_words) * _LANE_BITS / k
+
+    def wire_set_bytes(self, k: int, n: int) -> int:
+        return 4 * _layout(k, n, self.block).total_words
+
+    def bit_budget(self, k: int, n: int) -> Dict[str, float]:
+        lo = _layout(k, n, self.block)
+        return {
+            "values_bits": lo.val_words * _LANE_BITS / k,
+            "index_bits": (lo.up_words + lo.low_words) * _LANE_BITS / k,
+            "scale_bits": lo.scale_words * _LANE_BITS / k,
+            "total_bits": lo.total_words * _LANE_BITS / k,
+        }
+
+    # -- value quantization hooks (per-block, deterministic) --------------
+
+    def _quant(self, blocks: Array, s32: Array) -> Array:
+        raise NotImplementedError
+
+    def _dequant(self, qbytes: Array, s32: Array, kb: int) -> Array:
+        raise NotImplementedError
+
+    # -- wire assembly -----------------------------------------------------
+
+    def encode(self, vals: Array, idx: Array, *, n: int) -> Tuple[Array, ...]:
+        k = vals.shape[0]
+        lo = _layout(k, n, self.block)
+        # Order-canonical merge => sorting by index is free; sentinels
+        # (idx == n, value 0) sort to the tail and encode exactly.
+        sidx, svals = lax.sort((idx, vals), num_keys=1)
+
+        # Values: per-block bf16 scales; quantize against the ROUNDED
+        # scale (both ends multiply by the same bf16-derived f32).
+        kb = lo.n_blocks * self.block
+        blocks = jnp.pad(svals, (0, kb - k)).reshape(lo.n_blocks, self.block)
+        amax = jnp.max(jnp.abs(blocks), axis=1)
+        scale = (amax / self.qmax).astype(jnp.bfloat16)
+        s32 = scale.astype(jnp.float32)
+        qbytes = self._quant(blocks, s32)  # uint8[n_blocks, block]
+        val_w = _bytes_to_words(
+            jnp.pad(qbytes.reshape(-1)[:k], (0, lo.val_words * 4 - k)))
+
+        nb2 = lo.scale_words * 2
+        scale_w = lax.bitcast_convert_type(
+            jnp.pad(scale, (0, nb2 - lo.n_blocks)).reshape(-1, 2),
+            jnp.uint32)
+
+        # Indices: Elias-Fano split at l low bits.
+        iu = sidx.astype(jnp.uint32)
+        l = lo.l_bits
+        low_w = _pack_bits(
+            iu & jnp.uint32((1 << l) - 1) if l else iu * 0, l, lo.low_words)
+        pos = (sidx >> l) + jnp.arange(k, dtype=jnp.int32)
+        up = jnp.zeros((lo.up_words,), jnp.uint32).at[pos // _LANE_BITS].add(
+            jnp.left_shift(jnp.uint32(1),
+                           (pos % _LANE_BITS).astype(jnp.uint32)),
+            mode="drop")
+        return (jnp.concatenate([val_w, scale_w, up, low_w]),)
+
+    def decode(self, wire: Tuple[Array, ...], *, k: int, n: int
+               ) -> Tuple[Array, Array]:
+        lo = _layout(k, n, self.block)
+        words = wire[0]
+        a = lo.val_words
+        b = a + lo.scale_words
+        c = b + lo.up_words
+        val_w, scale_w, up, low_w = words[:a], words[a:b], words[b:c], words[c:]
+
+        scale = lax.bitcast_convert_type(
+            scale_w, jnp.bfloat16).reshape(-1)[:lo.n_blocks]
+        s32 = scale.astype(jnp.float32)
+
+        kb = lo.n_blocks * self.block
+        qbytes = jnp.pad(_words_to_bytes(val_w)[:k], (0, kb - k))
+        vals = self._dequant(qbytes, s32, kb)[:k]
+
+        # Exactly k set bits in a valid upper vector; an all-zero buffer
+        # (ppermute zero-fill at masked ranks) decodes to garbage the
+        # caller masks to sentinels before the merge.
+        bits = jnp.right_shift(
+            up[:, None], jnp.arange(_LANE_BITS, dtype=jnp.uint32)[None, :]
+        ) & jnp.uint32(1)
+        (pos,) = jnp.nonzero(bits.reshape(-1), size=k, fill_value=0)
+        high = pos.astype(jnp.int32) - jnp.arange(k, dtype=jnp.int32)
+        low = _unpack_bits(low_w, lo.l_bits, k).astype(jnp.int32)
+        idx = jnp.left_shift(high, lo.l_bits) | low
+        return vals, idx
+
+
+class Int8Codec(_QuantCodec):
+    base_name = "int8"
+    qmax = 127.0
+
+    def _quant(self, blocks: Array, s32: Array) -> Array:
+        denom = jnp.where(s32 > 0, s32, 1.0)[:, None]
+        q = jnp.clip(jnp.round(blocks / denom), -127.0, 127.0)
+        return lax.bitcast_convert_type(q.astype(jnp.int8), jnp.uint8)
+
+    def _dequant(self, qbytes: Array, s32: Array, kb: int) -> Array:
+        q = lax.bitcast_convert_type(qbytes, jnp.int8).astype(jnp.float32)
+        return (q.reshape(-1, self.block) * s32[:, None]).reshape(kb)
+
+
+class Fp8Codec(_QuantCodec):
+    base_name = "fp8"
+    qmax = 448.0  # float8_e4m3fn max finite
+
+    def _quant(self, blocks: Array, s32: Array) -> Array:
+        denom = jnp.where(s32 > 0, s32, 1.0)[:, None]
+        q = jnp.clip(blocks / denom, -448.0, 448.0)
+        return lax.bitcast_convert_type(
+            q.astype(jnp.float8_e4m3fn), jnp.uint8)
+
+    def _dequant(self, qbytes: Array, s32: Array, kb: int) -> Array:
+        q = lax.bitcast_convert_type(
+            qbytes, jnp.float8_e4m3fn).astype(jnp.float32)
+        return (q.reshape(-1, self.block) * s32[:, None]).reshape(kb)
+
+
+DEFAULT_BLOCK = 64
+
+#: Flag grammar: fp32 | int8[:BLOCK] | fp8[:BLOCK] (BLOCK a multiple of 4;
+#: default 64 — 0.25 scale bits/element).
+CODEC_NAMES = ("fp32", "int8", "fp8")
+
+_CACHE: Dict[str, WireCodec] = {}
+
+
+def get_codec(spec) -> WireCodec:
+    """Resolve a codec spec — a WireCodec instance passes through; a
+    string follows the ``fp32 | int8[:BLOCK] | fp8[:BLOCK]`` grammar."""
+    if isinstance(spec, WireCodec):
+        return spec
+    if spec is None:
+        spec = "fp32"
+    spec = str(spec)
+    if spec in _CACHE:
+        return _CACHE[spec]
+    base, _, blk = spec.partition(":")
+    if base not in CODEC_NAMES or (base == "fp32" and blk):
+        raise ValueError(
+            f"unknown wire codec {spec!r} (grammar: fp32 | int8[:BLOCK] "
+            f"| fp8[:BLOCK])")
+    if base == "fp32":
+        codec = WireCodec()
+    else:
+        try:
+            block = int(blk) if blk else DEFAULT_BLOCK
+        except ValueError:
+            raise ValueError(f"bad codec block size in {spec!r}")
+        codec = (Int8Codec if base == "int8" else Fp8Codec)(block)
+    _CACHE[spec] = codec
+    return codec
+
+
+def roundtrip_aligned(codec, vals: Array, idx: Array, *, n: int) -> Array:
+    """dequant(quant(vals)) returned in the ORIGINAL slot order of
+    (vals, idx) — what the sender will effectively contribute through the
+    wire. The compression layer folds (vals - roundtrip) into the
+    error-feedback residual (TopKCompressor.fold_wire_error) and ships the
+    roundtripped values, so repair of a globally-rejected pick restores
+    the ORIGINAL value exactly: roundtrip (from repair) + error (already
+    in the residual). Identity for fp32."""
+    codec = get_codec(codec)
+    if not codec.lossy:
+        return vals
+    qvals, _ = codec.decode(codec.encode(vals, idx, n=n),
+                            k=vals.shape[0], n=n)
+    # decode order is index-sorted; argsort(idx) maps sorted slot j back
+    # to original slot perm[j]. Ties are sentinel slots (value 0 both
+    # ways), so stable-vs-unstable tie order cannot change values.
+    perm = jnp.argsort(idx, stable=True)
+    return jnp.zeros_like(vals).at[perm].set(qvals)

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from gtopkssgd_tpu.ops import merge_sparse_sets, scatter_add_dense
+from gtopkssgd_tpu.parallel.codec import get_codec
 
 Array = jax.Array
 
@@ -68,6 +69,7 @@ def gtopk_allreduce(
     n: int,
     axis_name: str,
     axis_size: int,
+    codec="fp32",
 ) -> Tuple[Array, Array]:
     """Global top-k sparse allreduce over `axis_name` (hypercube ppermute).
 
@@ -89,7 +91,7 @@ def gtopk_allreduce(
     part_ranks = [[i] for i in range(axis_size)]
     return _merge_tree(vals, idx, k=k, n=n, axis_name=axis_name,
                        part_ranks=part_ranks,
-                       my_part=lax.axis_index(axis_name))
+                       my_part=lax.axis_index(axis_name), codec=codec)
 
 
 def tree_rounds(q: int) -> int:
@@ -105,7 +107,8 @@ def tree_rounds(q: int) -> int:
     return (q.bit_length() - 1) + 2
 
 
-def _merge_tree(vals, idx, *, k, n, axis_name, part_ranks, my_part):
+def _merge_tree(vals, idx, *, k, n, axis_name, part_ranks, my_part,
+                codec="fp32"):
     """Masked-hypercube merge-then-reselect over `q = len(part_ranks)`
     LOGICAL participants (the one tree under every gtopk variant: flat
     pow2, flat ragged, hierarchical cross-slice, hierarchical ragged).
@@ -133,28 +136,47 @@ def _merge_tree(vals, idx, *, k, n, axis_name, part_ranks, my_part):
     exact top-k of the full sparse sum; that approximation is the gTop-k
     algorithm itself, and error feedback absorbs it
     (compression.TopKCompressor.repair docstring).
+
+    Wire codec (parallel.codec): every round ships
+    ``codec.encode(vals, idx)`` instead of the raw pair and each side
+    merges DECODED sets — its own wire's decode against the partner's.
+    Because encode is deterministic, decode(own wire) on rank A is
+    bit-identical to what A's partner decodes, so both partners merge
+    the same pair of dequantized sets and the bitwise-agreement
+    invariant above survives quantization unchanged. The fp32 codec's
+    encode/decode are identity, reproducing the pre-codec tree
+    bit-for-bit. The unfold round requantizes on BOTH sides (extras
+    adopt the decoded wire, finished participants adopt their own
+    wire's decode) so all q participants still end bit-identical.
     """
     q = len(part_ranks)
+    codec = get_codec(codec)
     if q == 1:
         return vals, idx
     m = 1 << (q.bit_length() - 1)  # largest power of two <= q
     e = q - m                      # extra participants [m, q)
 
+    def ship(vals, idx, perm):
+        """Encode -> ppermute every wire buffer -> decode both ends."""
+        wire = codec.encode(vals, idx, n=n)
+        pwire = tuple(lax.ppermute(w, axis_name, perm) for w in wire)
+        return codec.decode(wire, k=k, n=n), codec.decode(pwire, k=k, n=n)
+
     def exchange(vals, idx, pairs, receives):
         """One ppermute round over participant `pairs` + merge. `receives`
         is a traced per-device bool — None when every device receives.
-        Non-receivers get ppermute's zero-fill; index 0 repeated k times
-        would break the merge's duplicates-come-in-pairs rule, so their
-        received set is turned into pure sentinel padding (merge no-op).
+        Non-receivers get ppermute's zero-fill (which a quantized codec
+        decodes to garbage); index 0 repeated k times would break the
+        merge's duplicates-come-in-pairs rule, so their received set is
+        turned into pure sentinel padding (merge no-op) AFTER decode.
         """
         perm = [(s, d) for a, b in pairs
                 for s, d in zip(part_ranks[a], part_ranks[b])]
-        pvals = lax.ppermute(vals, axis_name, perm)
-        pidx = lax.ppermute(idx, axis_name, perm)
+        (dvals, didx), (pvals, pidx) = ship(vals, idx, perm)
         if receives is not None:
             pvals = jnp.where(receives, pvals, 0.0)
             pidx = jnp.where(receives, pidx, n)
-        return merge_sparse_sets(vals, idx, pvals, pidx, k, n)
+        return merge_sparse_sets(dvals, didx, pvals, pidx, k, n)
 
     if e:
         # fold: extra m+t sends its set down to participant t (t < e)
@@ -166,14 +188,15 @@ def _merge_tree(vals, idx, *, k, n, axis_name, part_ranks, my_part):
                              [(a, a ^ bit) for a in range(m)],
                              my_part < m if e else None)
     if e:
-        # unfold: extras ADOPT (not merge) the finished global set
+        # unfold: extras ADOPT (not merge) the finished global set —
+        # through the codec, so extras and finished participants both
+        # hold decode(encode(final set)) and stay bit-identical.
         perm = [(s, d) for t in range(e)
                 for s, d in zip(part_ranks[t], part_ranks[m + t])]
-        pvals = lax.ppermute(vals, axis_name, perm)
-        pidx = lax.ppermute(idx, axis_name, perm)
+        (dvals, didx), (pvals, pidx) = ship(vals, idx, perm)
         extra = my_part >= m
-        vals = jnp.where(extra, pvals, vals)
-        idx = jnp.where(extra, pidx, idx)
+        vals = jnp.where(extra, pvals, dvals)
+        idx = jnp.where(extra, pidx, didx)
     return vals, idx
 
 
@@ -250,6 +273,7 @@ def hier_gtopk_allreduce(
     axis_name: str,
     axis_size: int,
     ici_size: int,
+    codec="fp32",
 ) -> Tuple[Array, Array]:
     """Cross-slice gTop-k hypercube (level 2 of the hierarchical mode).
 
@@ -272,7 +296,8 @@ def hier_gtopk_allreduce(
     ]
     return _merge_tree(vals, idx, k=k, n=n, axis_name=axis_name,
                        part_ranks=part_ranks,
-                       my_part=lax.axis_index(axis_name) // ici_size)
+                       my_part=lax.axis_index(axis_name) // ici_size,
+                       codec=codec)
 
 
 def topk_allgather(
@@ -283,14 +308,28 @@ def topk_allgather(
     n: int,
     axis_name: str,
     axis_size: int,
+    codec="fp32",
 ) -> Array:
     """DGC-style baseline (reference mode 'topk'/'topkA'): allgather every
     device's local top-k and apply the union — no global reselect, so every
     local pick lands and no residual repair is needed. Returns the DENSE
     summed update f32[n] (the union can hold up to k*P distinct indices, so a
-    sparse fixed-k return shape does not exist for this mode)."""
-    all_vals = lax.all_gather(vals, axis_name, tiled=True)
-    all_idx = lax.all_gather(idx, axis_name, tiled=True)
+    sparse fixed-k return shape does not exist for this mode).
+
+    With a quantized codec each device gathers the P encoded wire buffers
+    and decodes every one of them locally; decode is deterministic, so
+    the scattered union stays bit-identical across devices."""
+    codec = get_codec(codec)
+    if not codec.lossy:
+        all_vals = lax.all_gather(vals, axis_name, tiled=True)
+        all_idx = lax.all_gather(idx, axis_name, tiled=True)
+        return scatter_add_dense(n, all_idx, all_vals)
+    (wire,) = codec.encode(vals, idx, n=n)
+    all_wire = lax.all_gather(wire, axis_name, tiled=False)  # [P, W]
+    parts = [codec.decode((all_wire[r],), k=k, n=n)
+             for r in range(axis_size)]
+    all_vals = jnp.concatenate([v for v, _ in parts])
+    all_idx = jnp.concatenate([i for _, i in parts])
     return scatter_add_dense(n, all_idx, all_vals)
 
 
@@ -309,6 +348,7 @@ def sparse_allreduce(
     axis_name: str,
     axis_size: int,
     ici_size: int = 1,
+    codec="fp32",
 ) -> Tuple[Array, Array, bool]:
     """Mode dispatch preserving the reference's L2/L1 boundary.
 
@@ -329,45 +369,52 @@ def sparse_allreduce(
         # instead of one global top-k); the wire protocol is the same
         # fixed-K (vals, idx) set, so the hypercube runs unchanged.
         gvals, gidx = gtopk_allreduce(
-            vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size
+            vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size,
+            codec=codec,
         )
         return gvals, gidx, True
     if mode in HIER_MODES:
         gvals, gidx = hier_gtopk_allreduce(
             vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size,
-            ici_size=ici_size,
+            ici_size=ici_size, codec=codec,
         )
         return gvals, gidx, True
     if mode in ALLGATHER_MODES:
         dense = topk_allgather(
-            vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size
+            vals, idx, k=k, n=n, axis_name=axis_name, axis_size=axis_size,
+            codec=codec,
         )
         return dense, None, False
     raise ValueError(f"unknown sparse allreduce mode {mode!r}")
 
 
 def comm_bytes_per_step(mode: str, n: int, k: int, p: int,
-                        ici_size: int = 1) -> int:
+                        ici_size: int = 1, codec="fp32") -> int:
     """Per-device communication volume model (paper §3 complexity table):
-    gtopk O(k log P), allgather O(k P), dense O(N). 8 bytes per (f32, i32)
-    element pair; dense counts 4-byte f32 once per element (ring allreduce
-    moves ~2N elements, we report the N model like the paper).
+    gtopk O(k log P), allgather O(k P), dense O(N). Each sparse round
+    ships one codec-encoded k-of-n set (``codec.wire_set_bytes`` —
+    parallel.codec; the fp32 default is the historical 8 bytes per
+    (f32, i32) element pair); dense counts 4-byte f32 once per element
+    (ring allreduce moves ~2N elements, we report the N model like the
+    paper).
 
     'gtopk_hier' reports the two levels summed: a dense O(N) within the
     slice (which rides ICI — fast links, usually not the bottleneck the
-    model is meant to expose) plus the sparse O(k log(P/ici)) across
-    slices (the DCN hop the hierarchy exists to thin out)."""
+    model is meant to expose, and always fp32: the codec applies to the
+    sparse set only) plus the sparse O(k log(P/ici)) across slices (the
+    DCN hop the hierarchy exists to thin out)."""
+    set_bytes = get_codec(codec).wire_set_bytes(k, n)
     if mode in GTOPK_MODES or mode in LAYERWISE_MODES:
         # layerwise: same wire protocol, K differs from rho*N only by the
         # +1-per-tiny-layer rounding of k_l = ceil(rho * n_l).
-        return 8 * k * max(1, tree_rounds(p))
+        return set_bytes * max(1, tree_rounds(p))
     if mode in HIER_MODES:
         n_slices = max(1, p // max(1, ici_size))
-        sparse = 8 * k * tree_rounds(n_slices)
+        sparse = set_bytes * tree_rounds(n_slices)
         dense = 4 * n if ici_size > 1 else 0
         return dense + sparse
     if mode in ALLGATHER_MODES:
-        return 8 * k * p
+        return set_bytes * p
     if mode in DENSE_MODES:
         return 4 * n
     raise ValueError(f"unknown mode {mode!r}")

@@ -85,6 +85,9 @@ class TrainConfig:
     hier_ici: int = 1              # gtopk_hier: devices per ICI slice (dense
                                    # psum within, gtopk across slices)
     topk_method: str = "auto"
+    wire_codec: str = "fp32"       # on-wire sparse-set encoding for every
+                                   # exchange round (parallel.codec grammar:
+                                   # fp32 | int8[:BLOCK] | fp8[:BLOCK])
     clip_grad_norm: Optional[float] = None  # default: LSTMs clip (ref §3.4)
     nsteps_update: int = 1
     warmup_epochs: int = 0         # linear LR ramp over the first N epochs
@@ -493,6 +496,7 @@ class Trainer:
             compression=cfg.compression,
             density=cfg.density,
             topk_method=cfg.topk_method,
+            wire_codec=cfg.wire_codec,
             clip_grad_norm=cfg.clip_grad_norm,
             axis_name="dp" if self.p > 1 else None,
             hier_ici_size=cfg.hier_ici,

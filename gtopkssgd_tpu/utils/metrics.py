@@ -54,6 +54,8 @@ KINDS = frozenset({
                    # (resilience/policy.py, trainer emergency save)
     "twostage",    # twostage-vs-exact A/B evidence row (gate smoke):
                    # audit recall + T_select fractions for both methods
+    "codec",       # wire-codec A/B evidence row (gate smoke): measured
+                   # int8-vs-fp32 wire-bytes ratios, ledger audit, recall
 })
 
 _SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")
