@@ -49,6 +49,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from gtopkssgd_tpu.exit_codes import EXIT_BENCH_TUNNEL_DEAD  # noqa: E402
+
 RESULTS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "results")
 
@@ -286,7 +288,7 @@ def main():
         json.dump(art, f, indent=1)
     print(json.dumps({"artifact": out_path, "rows": len(rows)}))
     if aborted:
-        raise SystemExit(3)
+        raise SystemExit(EXIT_BENCH_TUNNEL_DEAD)
 
 
 if __name__ == "__main__":

@@ -312,7 +312,39 @@ def run_smoke(out_dir: str) -> str:
         # floor under the lossy codec, and the ledger's modeled-vs-
         # measured bytes ratio.
         t.metrics.log("codec", **codec_rec)
+        # Static-analysis gate: run graftlint in-process over the
+        # package + benchmarks against the committed repo baseline and
+        # record the counts; the gate pins non_baselined at exactly 0,
+        # so a new invariant violation fails the same drift gate as a
+        # numeric regression.
+        t.metrics.log("lint", **run_lint_smoke())
     return out_dir
+
+
+def run_lint_smoke() -> dict:
+    """Graftlint finding counts for the shipped tree, as a gate record.
+
+    Uses the analysis engine directly (no subprocess, no jax) with the
+    repo-root baseline, scanning the same paths CI lints:
+    gtopkssgd_tpu/ and benchmarks/.
+    """
+    from gtopkssgd_tpu.analysis import ALL_RULES, load_baseline, run
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = run(
+        [os.path.join(repo, "gtopkssgd_tpu"),
+         os.path.join(repo, "benchmarks")],
+        rules=ALL_RULES,
+        baseline=load_baseline(
+            os.path.join(repo, "graftlint_baseline.json")),
+        root=repo)
+    return {
+        "files_scanned": result.files_scanned,
+        "non_baselined": len(result.findings),
+        "baselined": len(result.baselined),
+        "suppressed": len(result.suppressed),
+        "stale_baseline": len(result.stale_baseline),
+    }
 
 
 def main(argv=None) -> int:

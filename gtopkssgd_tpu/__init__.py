@@ -44,6 +44,13 @@ __version__ = "0.1.0"
 # forwarding aliases so every module (and the tests) can use the one
 # spelling regardless of the installed jax. No-op on jax versions that
 # already export them.
+#
+# The install is DEFERRED: importing this package must not itself import
+# jax, because jax-free consumers exist — graftlint
+# (``python -m gtopkssgd_tpu.analysis``) is pure stdlib-ast by contract
+# and must run in seconds on a box whose accelerator tunnel is dead.
+# A one-shot meta-path hook installs the aliases the moment anything
+# first imports jax; if jax is already loaded, they install right away.
 
 
 def _install_jax_compat() -> None:
@@ -73,4 +80,45 @@ def _install_jax_compat() -> None:
         lax.axis_size = axis_size
 
 
-_install_jax_compat()
+def _defer_jax_compat() -> None:
+    import importlib.util
+    import sys
+
+    if "jax" in sys.modules:
+        _install_jax_compat()
+        return
+
+    class _JaxCompatHook:
+        """One-shot finder: resolves the real jax spec, wraps its
+        loader so the compat aliases install immediately after jax's
+        own __init__ runs, then retires itself."""
+
+        _busy = False
+
+        def find_spec(self, name, path=None, target=None):
+            if name != "jax" or _JaxCompatHook._busy:
+                return None
+            _JaxCompatHook._busy = True
+            try:
+                spec = importlib.util.find_spec("jax")
+            finally:
+                _JaxCompatHook._busy = False
+            try:
+                sys.meta_path.remove(self)
+            except ValueError:
+                pass
+            if spec is None or spec.loader is None:
+                return spec
+            orig_exec = spec.loader.exec_module
+
+            def exec_module(module, _orig=orig_exec):
+                _orig(module)
+                _install_jax_compat()
+
+            spec.loader.exec_module = exec_module
+            return spec
+
+    sys.meta_path.insert(0, _JaxCompatHook())
+
+
+_defer_jax_compat()

@@ -1,0 +1,99 @@
+"""CLI: ``python -m gtopkssgd_tpu.analysis [paths...]``.
+
+Exit codes come from the registry this tool itself enforces
+(gtopkssgd_tpu.exit_codes): 0 clean, 1 non-baselined findings, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+from gtopkssgd_tpu.analysis import engine, reporters
+from gtopkssgd_tpu.analysis.rules import ALL_RULES, RULES_BY_NAME
+
+DEFAULT_BASELINE = "graftlint_baseline.json"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        "gtopkssgd_tpu.analysis",
+        description="graftlint: AST invariant checker for the jitted "
+                    "hot path, the metric/exit-code registries, and "
+                    "codec-mediated collectives.")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to scan (default: the "
+                         "gtopkssgd_tpu package next to this analyzer)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help=f"baseline JSON of grandfathered findings "
+                         f"(default: ./{DEFAULT_BASELINE} when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline: report every finding")
+    ap.add_argument("--write-baseline", default=None, metavar="PATH",
+                    help="grandfather the current findings into PATH "
+                         "(carries forward reasons for unchanged keys) "
+                         "and exit 0")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="RULE", help="run only this rule (repeat "
+                                         "for several)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print suppressed/baselined findings")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.name:20s} {doc}")
+        return 0
+
+    if args.rule:
+        unknown = sorted(set(args.rule) - set(RULES_BY_NAME))
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline = {}
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
+    if baseline_path and not args.no_baseline:
+        try:
+            baseline = engine.load_baseline(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"cannot read baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    result = engine.run(
+        paths, rules=ALL_RULES, baseline=baseline,
+        rule_names=set(args.rule) if args.rule else None)
+
+    if args.write_baseline:
+        engine.write_baseline(
+            args.write_baseline,
+            result.findings + result.baselined, old=baseline)
+        print(f"wrote {len(result.findings) + len(result.baselined)} "
+              f"baseline entries to {args.write_baseline}")
+        return 0
+
+    if args.json:
+        reporters.json_report(result, sys.stdout)
+    else:
+        reporters.text_report(result, sys.stdout, verbose=args.verbose)
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,524 @@
+"""The graftlint rule set — five invariants, each born from a real bug
+or a convention that was previously enforced by grep, docstring, or
+reviewer memory.
+
+Registry-backed rules (metric-kind, exit-code) read their registries
+from the package SOURCE by AST — never by import, which would
+initialize a JAX backend — so the analyzer stays silicon-free. When the
+scanned file set itself contains ``utils/metrics.py`` / a registry
+module, that copy wins (fixture trees in tests override the installed
+package); otherwise the files shipped next to this analyzer are read.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from gtopkssgd_tpu.analysis.callgraph import (
+    CallGraph,
+    FuncInfo,
+    ModuleInfo,
+    expr_is_traced,
+    own_statements,
+    traced_names,
+)
+from gtopkssgd_tpu.analysis.engine import Finding, SourceFile
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _snippet(node: ast.AST, limit: int = 80) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:
+        text = type(node).__name__
+    return text if len(text) <= limit else text[:limit - 3] + "..."
+
+
+def _enclosing(sf: SourceFile, node: ast.AST) -> str:
+    """Qualified name of the innermost function containing ``node``
+    (line-range containment — good enough for display/baseline keys)."""
+    best = "<module>"
+    best_span = None
+    target = getattr(node, "lineno", None)
+    if target is None:
+        return best
+    stack: List[Tuple[ast.AST, str]] = [(sf.tree, "")]
+    while stack:
+        cur, prefix = stack.pop()
+        for child in ast.iter_child_nodes(cur):
+            name = getattr(child, "name", None)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = f"{prefix}{name}"
+                lo = child.lineno
+                hi = max((getattr(n, "lineno", lo)
+                          for n in ast.walk(child)), default=lo)
+                if lo <= target <= hi and not isinstance(
+                        child, ast.ClassDef):
+                    span = hi - lo
+                    if best_span is None or span <= best_span:
+                        best, best_span = qual, span
+                stack.append((child, qual + "."))
+            else:
+                stack.append((child, prefix))
+    return best
+
+
+def _finding(rule: str, sf: SourceFile, node: ast.AST, message: str,
+             symbol: Optional[str] = None) -> Finding:
+    return Finding(
+        rule=rule, path=sf.rel,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0),
+        message=message,
+        symbol=symbol or _enclosing(sf, node),
+        snippet=_snippet(node))
+
+
+# --------------------------------------------------------------------------
+# Registry extraction (AST only — see module docstring).
+# --------------------------------------------------------------------------
+
+def _load_source(files: Sequence[SourceFile],
+                 rel_suffix: str) -> Optional[ast.AST]:
+    for sf in files:
+        if sf.rel.endswith(rel_suffix):
+            return sf.tree
+    fallback = os.path.join(_PKG_DIR, *rel_suffix.split("/"))
+    if os.path.exists(fallback):
+        with open(fallback, encoding="utf-8") as fh:
+            return ast.parse(fh.read(), filename=fallback)
+    return None
+
+
+def registered_kinds(files: Sequence[SourceFile] = ()) -> Set[str]:
+    """``utils.metrics.KINDS`` recovered from source."""
+    tree = _load_source(files, "utils/metrics.py")
+    kinds: Set[str] = set()
+    if tree is None:
+        return kinds
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "KINDS"
+                   for t in node.targets):
+            continue
+        for leaf in ast.walk(node.value):
+            if isinstance(leaf, ast.Constant) and isinstance(
+                    leaf.value, str):
+                kinds.add(leaf.value)
+    return kinds
+
+
+def exit_code_registry(
+        files: Sequence[SourceFile] = ()) -> Dict[int, List[str]]:
+    """``gtopkssgd_tpu.exit_codes`` constants from source:
+    {code: [names...]} — more than one name per code is a collision."""
+    tree = _load_source(files, "exit_codes.py")
+    out: Dict[int, List[str]] = {}
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)
+                and not isinstance(node.value.value, bool)):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id.startswith("EXIT_"):
+                out.setdefault(node.value.value, []).append(t.id)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Rule 1: host-sync-in-jit
+# --------------------------------------------------------------------------
+
+class HostSyncInJitRule:
+    """No host synchronization inside the jitted hot path.
+
+    The dispatch-stall watchdog (obs/watchdog.py) exists because a
+    single blocking host read of a device value once hung a run for its
+    whole uptime window. This rule makes the invariant static: build
+    the jit/pmap/shard_map reachability set (callgraph.py) and flag,
+    inside it, ``.item()``, ``jax.device_get``, ``float()``/``int()``
+    coercions of traced values, ``np.asarray`` of traced values, and
+    ``print`` of traced values.
+    """
+
+    name = "host-sync-in-jit"
+
+    _COERCIONS = {"float", "int", "bool", "complex"}
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        graph = CallGraph(files)
+        findings: List[Finding] = []
+        for fi in graph.reachable_functions():
+            m = graph.by_rel[fi.sf.rel]
+            tainted = traced_names(fi)
+            for node in own_statements(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                findings.extend(
+                    self._check_call(m, fi, node, tainted))
+        return findings
+
+    def _check_call(self, m: ModuleInfo, fi: FuncInfo, node: ast.Call,
+                    tainted: Set[str]) -> List[Finding]:
+        out: List[Finding] = []
+        func = node.func
+        where = f"jit-reachable `{fi.qualname}`"
+
+        def flag(msg: str) -> None:
+            out.append(_finding(self.name, fi.sf, node,
+                                f"{msg} inside {where}",
+                                symbol=fi.qualname))
+
+        if isinstance(func, ast.Attribute) and func.attr == "item" \
+                and not node.args and not node.keywords:
+            flag("`.item()` forces a device->host sync")
+            return out
+        full = m.full_name(func)
+        if full in {"jax.device_get", "device_get"}:
+            flag("`jax.device_get` forces a device->host transfer")
+            return out
+        if full in {"np.asarray", "numpy.asarray", "np.array",
+                    "numpy.array"} and node.args and expr_is_traced(
+                        node.args[0], tainted):
+            flag(f"`{full}` of a traced value forces a host transfer")
+            return out
+        if isinstance(func, ast.Name):
+            if func.id == "print" and any(
+                    expr_is_traced(a, tainted) for a in node.args):
+                flag("`print` of a traced value syncs (use jax.debug."
+                     "print for traced debugging)")
+            elif (func.id in self._COERCIONS and len(node.args) == 1
+                    and expr_is_traced(node.args[0], tainted)):
+                flag(f"`{func.id}()` of a traced value blocks on the "
+                     "dispatched computation")
+        return out
+
+
+# --------------------------------------------------------------------------
+# Shared .log( call-site model (rules 2 and 5)
+# --------------------------------------------------------------------------
+
+_LOG_EXCLUDED_ROOTS = {"np", "jnp", "numpy", "math", "logging", "torch"}
+
+
+def _metric_log_calls(m: ModuleInfo):
+    """Yield (call, resolved_kind | None, reason) for every call site
+    that looks like ``MetricsLogger.log`` — an attribute call named
+    ``log`` with a positional first argument, excluding numeric/stdlib
+    ``log`` receivers (np.log, math.log, Logger handles named *logger*).
+    resolved_kind is the first argument as a string when it is a
+    literal or a name statically bound to one; reason explains the
+    failure otherwise ("f-string", "unresolved")."""
+    for node in ast.walk(m.sf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "log" and node.args):
+            continue
+        recv = m.full_name(node.func.value)
+        if recv:
+            parts = recv.split(".")
+            if parts[0] in _LOG_EXCLUDED_ROOTS or any(
+                    "logger" in p.lower() for p in parts):
+                continue
+        kind, reason = _resolve_kind(m, node, node.args[0])
+        yield node, kind, reason
+
+
+def _resolve_kind(m: ModuleInfo, call: ast.Call,
+                  arg: ast.AST) -> Tuple[Optional[str], str]:
+    if isinstance(arg, ast.Constant):
+        if isinstance(arg.value, str):
+            return arg.value, ""
+        return None, f"non-string literal {arg.value!r}"
+    if isinstance(arg, ast.JoinedStr):
+        return None, "f-string (dynamic kind)"
+    if isinstance(arg, ast.Name):
+        # Nearest static binding: a function-local `k = "obs"` wins over
+        # a module-level constant of the same name.
+        for scope in (_enclosing_node(m.sf.tree, call), m.sf.tree):
+            if scope is None:
+                continue
+            bound = _string_binding(scope, arg.id)
+            if bound is not None:
+                return bound, ""
+        return None, f"name `{arg.id}` not bound to a string constant"
+    return None, "dynamic kind expression"
+
+
+def _enclosing_node(tree: ast.AST, target: ast.AST) -> Optional[ast.AST]:
+    line = getattr(target, "lineno", None)
+    if line is None:
+        return None
+    best, best_span = None, None
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        hi = max((getattr(n, "lineno", node.lineno)
+                  for n in ast.walk(node)), default=node.lineno)
+        if node.lineno <= line <= hi:
+            span = hi - node.lineno
+            if best_span is None or span <= best_span:
+                best, best_span = node, span
+    return best
+
+
+def _string_binding(scope: ast.AST, name: str) -> Optional[str]:
+    value: Optional[str] = None
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Constant) and isinstance(
+                    node.value.value, str):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    value = node.value.value
+    return value
+
+
+# --------------------------------------------------------------------------
+# Rule 2: metric-kind
+# --------------------------------------------------------------------------
+
+class MetricKindRule:
+    """Every ``.log(...)`` kind must be a member of
+    ``utils.metrics.KINDS``, resolved statically. Supersedes the PR 4
+    grep test: the AST resolver also follows names bound to string
+    constants and rejects f-strings/dynamic expressions the grep could
+    not see."""
+
+    name = "metric-kind"
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        kinds = registered_kinds(files)
+        if not kinds:
+            return []
+        findings: List[Finding] = []
+        for sf in files:
+            m = ModuleInfo(sf)
+            for call, kind, reason in _metric_log_calls(m):
+                if kind is not None:
+                    if kind not in kinds:
+                        findings.append(_finding(
+                            self.name, sf, call,
+                            f"unregistered metrics kind {kind!r} — add "
+                            "it to gtopkssgd_tpu.utils.metrics.KINDS"))
+                else:
+                    findings.append(_finding(
+                        self.name, sf, call,
+                        f"metrics kind is not statically resolvable "
+                        f"({reason}) — use a registered literal"))
+        return findings
+
+
+# --------------------------------------------------------------------------
+# Rule 3: exit-code
+# --------------------------------------------------------------------------
+
+class ExitCodeRule:
+    """Process exit codes are a cross-tool contract (drivers and retry
+    loops classify runs by rc without parsing logs), so every literal
+    ``sys.exit`` / ``SystemExit`` / ``os._exit`` code must come from the
+    single-source registry ``gtopkssgd_tpu/exit_codes.py`` — and no
+    module may mint its own ``*_EXIT_CODE`` constant outside it."""
+
+    name = "exit-code"
+
+    _EXIT_CALLS = {"sys.exit", "os._exit", "SystemExit", "exit"}
+    _CONST_RE = re.compile(r"(^EXIT_|_EXIT_CODE$|^EXITCODE)")
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        registry = exit_code_registry(files)
+        findings: List[Finding] = []
+        for code, names in sorted(registry.items()):
+            if len(names) > 1:
+                reg = [sf for sf in files
+                       if sf.rel.endswith("exit_codes.py")]
+                sf = reg[0] if reg else files[0]
+                findings.append(Finding(
+                    rule=self.name, path=sf.rel, line=1, col=0,
+                    message=f"exit-code collision: {sorted(names)} all "
+                            f"map to {code}",
+                    symbol="<registry>", snippet=str(code)))
+        known = set(registry)
+        for sf in files:
+            m = ModuleInfo(sf)
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call):
+                    full = m.full_name(node.func)
+                    if full in self._EXIT_CALLS and node.args:
+                        code = _int_literal(node.args[0])
+                        if code is not None and code not in known:
+                            findings.append(_finding(
+                                self.name, sf, node,
+                                f"exit code {code} is not in the "
+                                "gtopkssgd_tpu.exit_codes registry"))
+                elif isinstance(node, ast.Assign):
+                    if sf.rel.endswith("exit_codes.py"):
+                        continue
+                    code = _int_literal(node.value)
+                    if code is None:
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and self._CONST_RE.search(
+                                t.id):
+                            findings.append(_finding(
+                                self.name, sf, node,
+                                f"exit-code constant `{t.id} = {code}` "
+                                "defined outside gtopkssgd_tpu/"
+                                "exit_codes.py — import it from the "
+                                "registry instead"))
+        return findings
+
+
+def _int_literal(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _int_literal(node.operand)
+        return -inner if inner is not None else None
+    return None
+
+
+# --------------------------------------------------------------------------
+# Rule 4: codec-wire
+# --------------------------------------------------------------------------
+
+class CodecWireRule:
+    """Every sparse (vals, idx) exchange in ``parallel/`` must flow
+    through the wire codec (``codec.encode`` / the merge tree's
+    ``ship()``), so no collective can silently bypass the wire format
+    and break cross-rank bit-identity. Dense payloads (ici psum, the
+    dense baseline) are exempt — the codec applies to sparse sets
+    only."""
+
+    name = "codec-wire"
+
+    _COLLECTIVES = {"lax.ppermute", "jax.lax.ppermute",
+                    "lax.all_gather", "jax.lax.all_gather",
+                    "lax.psum", "jax.lax.psum",
+                    "lax.psum_scatter", "jax.lax.psum_scatter"}
+    _SPARSE_NAME = re.compile(
+        r"(^|_)(vals|idx|indices|values)$", re.IGNORECASE)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in files:
+            if "parallel/" not in sf.rel:
+                continue
+            m = ModuleInfo(sf)
+            for fi in m.funcs:
+                sanctioned = self._wire_names(fi)
+                for node in own_statements(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if m.full_name(node.func) not in self._COLLECTIVES:
+                        continue
+                    if not node.args:
+                        continue
+                    payload = node.args[0]
+                    names = {n.id for n in ast.walk(payload)
+                             if isinstance(n, ast.Name)}
+                    if names & sanctioned:
+                        continue  # ships codec.encode output
+                    sparse = sorted(
+                        n for n in names if self._SPARSE_NAME.search(n))
+                    if sparse:
+                        findings.append(_finding(
+                            self.name, sf, node,
+                            f"raw collective ships sparse payload "
+                            f"({', '.join(sparse)}) without "
+                            "codec.encode/ship() — every sparse "
+                            "exchange must go through the wire codec",
+                            symbol=fi.qualname))
+        return findings
+
+    def _wire_names(self, fi: FuncInfo) -> Set[str]:
+        """Names holding codec.encode output (directly, via unpacking,
+        or iterated element-wise) — the sanctioned wire buffers."""
+        sanctioned: Set[str] = set()
+
+        def rhs_is_wire(value: ast.AST) -> bool:
+            for n in ast.walk(value):
+                if isinstance(n, ast.Call) and isinstance(
+                        n.func, ast.Attribute) and n.func.attr == "encode":
+                    return True
+                if isinstance(n, ast.Name) and n.id in sanctioned:
+                    return True
+            return False
+
+        changed = True
+        while changed:
+            changed = False
+            for node in own_statements(fi.node):
+                pairs: List[Tuple[ast.AST, ast.AST]] = []
+                if isinstance(node, ast.Assign):
+                    pairs = [(t, node.value) for t in node.targets]
+                elif isinstance(node, ast.For):
+                    pairs = [(node.target, node.iter)]
+                elif isinstance(node, ast.comprehension):
+                    pairs = [(node.target, node.iter)]
+                for target, value in pairs:
+                    if not rhs_is_wire(value):
+                        continue
+                    for leaf in ast.walk(target):
+                        if isinstance(leaf, ast.Name) \
+                                and leaf.id not in sanctioned:
+                            sanctioned.add(leaf.id)
+                            changed = True
+        return sanctioned
+
+
+# --------------------------------------------------------------------------
+# Rule 5: durable-event
+# --------------------------------------------------------------------------
+
+class DurableEventRule:
+    """Records that exist to survive a hard kill — anomaly ``event``s,
+    injected-fault ``inject`` firings, ``recovery`` actions — must be
+    fsync'd at the call site: ``.log(kind, flush=True, ...)``. Line
+    buffering alone only reaches the OS, and these kinds are exactly the
+    ones read back after a crash."""
+
+    name = "durable-event"
+
+    DURABLE_KINDS = {"event", "inject", "recovery"}
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in files:
+            m = ModuleInfo(sf)
+            for call, kind, _reason in _metric_log_calls(m):
+                if kind not in self.DURABLE_KINDS:
+                    continue
+                flush = next((kw.value for kw in call.keywords
+                              if kw.arg == "flush"), None)
+                ok = (isinstance(flush, ast.Constant)
+                      and flush.value is True)
+                if not ok:
+                    findings.append(_finding(
+                        self.name, sf, call,
+                        f"durable kind {kind!r} logged without "
+                        "flush=True — the record must be fsync'd to "
+                        "survive a hard kill"))
+        return findings
+
+
+ALL_RULES = (
+    HostSyncInJitRule(),
+    MetricKindRule(),
+    ExitCodeRule(),
+    CodecWireRule(),
+    DurableEventRule(),
+)
+
+RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -96,8 +96,11 @@ detect-and-recover):
     --allow-ckpt-mismatch                restore past a config_hash/state-
                                          digest integrity mismatch
 
-Exit code registry: 0 ok, 43 stall watchdog, 44 anomaly halt, 45
-preempted-after-save.
+Exit codes come from the single-source registry
+``gtopkssgd_tpu/exit_codes.py`` (0 ok, 43 stall watchdog, 44 anomaly
+halt, 45 preempted-after-save, 99 multihost designed skip — see that
+module for the full table; graftlint's exit-code rule rejects literals
+minted anywhere else).
 
 Summarize or diff the resulting metrics.jsonl with
 ``python -m gtopkssgd_tpu.obs.report <out-dir> [<other-out-dir>]``.

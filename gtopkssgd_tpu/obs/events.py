@@ -47,8 +47,10 @@ import dataclasses
 import math
 from typing import Any, Dict, List, Optional
 
-# Exit code for --obs-halt-on fail-fast (watchdog stalls exit 43).
-HALT_EXIT_CODE = 44
+# Exit code for --obs-halt-on fail-fast (watchdog stalls exit
+# EXIT_STALL). Single source: gtopkssgd_tpu/exit_codes.py, re-exported
+# under the historical name every consumer already imports.
+from gtopkssgd_tpu.exit_codes import EXIT_ANOMALY_HALT as HALT_EXIT_CODE
 
 _SEVERITY_RANK = {"info": 0, "warn": 1, "error": 2}
 

@@ -35,7 +35,9 @@ from typing import Callable, Dict, Optional
 
 # Exit code for a detected stall — distinct from generic failure so the
 # driver/retry loop can classify hung-tunnel runs without parsing logs.
-STALL_EXIT_CODE = 43
+# Single source: gtopkssgd_tpu/exit_codes.py (re-exported here under the
+# historical name every consumer already imports).
+from gtopkssgd_tpu.exit_codes import EXIT_STALL as STALL_EXIT_CODE
 
 
 def _device_info() -> Dict[str, object]:

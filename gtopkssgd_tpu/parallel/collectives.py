@@ -316,17 +316,20 @@ def topk_allgather(
     summed update f32[n] (the union can hold up to k*P distinct indices, so a
     sparse fixed-k return shape does not exist for this mode).
 
-    With a quantized codec each device gathers the P encoded wire buffers
-    and decodes every one of them locally; decode is deterministic, so
-    the scattered union stays bit-identical across devices."""
+    Every codec takes the same path: encode the local set into wire
+    buffers, gather each buffer across the axis, decode all P rank
+    slices locally. Decode is deterministic, so the scattered union
+    stays bit-identical across devices — and the fp32 codec's
+    encode/decode are identities, so for the non-lossy default this
+    lowers to exactly the historical raw (vals, idx) gather while
+    keeping the exchange on the audited ``codec.encode`` path (the
+    codec-wire lint invariant: no sparse payload crosses the wire
+    unencoded)."""
     codec = get_codec(codec)
-    if not codec.lossy:
-        all_vals = lax.all_gather(vals, axis_name, tiled=True)
-        all_idx = lax.all_gather(idx, axis_name, tiled=True)
-        return scatter_add_dense(n, all_idx, all_vals)
-    (wire,) = codec.encode(vals, idx, n=n)
-    all_wire = lax.all_gather(wire, axis_name, tiled=False)  # [P, W]
-    parts = [codec.decode((all_wire[r],), k=k, n=n)
+    wire = codec.encode(vals, idx, n=n)
+    all_wire = tuple(lax.all_gather(w, axis_name, tiled=False)
+                     for w in wire)  # each [P, ...]
+    parts = [codec.decode(tuple(w[r] for w in all_wire), k=k, n=n)
              for r in range(axis_size)]
     all_vals = jnp.concatenate([v for v, _ in parts])
     all_idx = jnp.concatenate([i for _, i in parts])

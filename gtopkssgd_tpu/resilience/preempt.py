@@ -27,8 +27,10 @@ import time
 from typing import Any, Callable, Optional, Tuple, Type
 
 # Exit code for a preemption-triggered shutdown after the emergency
-# save. The registry: 43 = stall watchdog, 44 = anomaly halt, 45 = this.
-PREEMPT_EXIT_CODE = 45
+# save. Single source: gtopkssgd_tpu/exit_codes.py (EXIT_STALL = stall
+# watchdog, EXIT_ANOMALY_HALT = anomaly halt, EXIT_PREEMPTED = this),
+# re-exported under the historical name every consumer already imports.
+from gtopkssgd_tpu.exit_codes import EXIT_PREEMPTED as PREEMPT_EXIT_CODE
 
 
 class Preempted(RuntimeError):

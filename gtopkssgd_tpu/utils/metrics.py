@@ -32,8 +32,9 @@ from typing import Any, Callable, Dict, Optional
 # Registered record kinds. Shared with the report CLI (which flags
 # unregistered kinds in a run) and enforced at log() time, so a typo'd
 # kind fails loudly instead of silently vanishing from every report;
-# tests/test_obs_fleet.py greps the tree's `.log("` call sites against
-# this set.
+# graftlint's metric-kind rule (gtopkssgd_tpu/analysis) additionally
+# resolves every static `.log(...)` call site against this set, so a
+# typo is caught before any run.
 KINDS = frozenset({
     "manifest",    # run provenance header (obs/manifest.py), first record
     "train",       # per-log-interval training stats
@@ -56,6 +57,9 @@ KINDS = frozenset({
                    # audit recall + T_select fractions for both methods
     "codec",       # wire-codec A/B evidence row (gate smoke): measured
                    # int8-vs-fp32 wire-bytes ratios, ledger audit, recall
+    "lint",        # graftlint summary row (gate smoke): finding counts
+                   # from python -m gtopkssgd_tpu.analysis, gated at 0
+                   # non-baselined findings
 })
 
 _SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")

@@ -114,6 +114,9 @@ def test_gtopk_converges_under_approx_selection(dense_losses):
     assert approx[-1] < dense_losses[0]
 
 
+@pytest.mark.slow  # ~127 s: long-horizon run at rho=0.001; the short-
+# horizon operating-point coverage stays tier-1 via
+# test_gtopk_tracks_dense / test_gtopk_converges_under_approx_selection
 def test_gtopk_rho001_long_horizon():
     """The paper's operating point (rho=0.001, k=273 of 272k) over a long
     horizon. Calibrated on this exact setup (seed-pinned, CPU): the 300-step

@@ -12,6 +12,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -41,6 +43,9 @@ def test_dryrun_multichip_self_provisions_from_one_device():
     assert "one gtopk step OK" in proc.stdout
 
 
+@pytest.mark.slow  # ~43 s subprocess; the self-provisioning variant
+# below exercises the same dryrun step plus the re-exec path, so this
+# direct-path twin is the redundant half of the pair
 def test_dryrun_multichip_direct_path():
     """Parent already has >= 8 devices -> runs in-process."""
     proc = subprocess.run(

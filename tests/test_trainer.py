@@ -236,6 +236,10 @@ def test_warmup_cli_flags():
     assert cfg.warmup_epochs == 2 and cfg.dense_warmup_epochs == 3
 
 
+@pytest.mark.slow  # ~42 s: multi-epoch fit() loop; the checkpoint
+# save/resume contract stays tier-1 via
+# test_checkpoint_roundtrip_preserves_residual and the layerwise/
+# momentum-correction roundtrip tests
 def test_fit_epoch_loop_with_checkpoint(tmp_path, monkeypatch):
     """fit() (reference dist_trainer main loop): epoch-driven train + eval +
     checkpoint each epoch; a fresh Trainer resumes into the NEXT epoch."""
