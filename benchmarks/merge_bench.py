@@ -140,6 +140,48 @@ VARIANTS = {
     "dense_scatter": time_dense_scatter,
 }
 
+PLAN_WORKERS = (8, 16, 32)
+
+
+def plan_rows(sizes: dict, densities) -> list:
+    """Model-side balanced-vs-tree schedule comparison at the same
+    operating points (no mesh needed — these are the planner's own
+    inputs: comm_bytes_per_step for per-rank wire volume, the scaling
+    model for projected ms). One row per (size, density, P) so BENCH
+    rounds carry the crossover evidence next to the measured merge cost:
+    balanced wire is O(k) flat in P, the tree's O(k log P), so
+    bytes_ratio < 1 from P=8 up at these shapes."""
+    from benchmarks.scaling_model import predict
+    from gtopkssgd_tpu.parallel import balanced_cap, comm_bytes_per_step
+    from gtopkssgd_tpu.parallel.planner import planner_inputs
+
+    # Price with exactly what the planner scores with (the committed
+    # dcn_probe alpha-beta fit when present, documented fallbacks else).
+    inp = planner_inputs()
+    model = dict(ici_gbps=inp["ici_gbps"], dcn_gbps=inp["beta_gbps"],
+                 dcn_alpha_ms=inp["alpha_ms"], ici_size=1)
+    rows = []
+    for label, n in sizes.items():
+        for rho in densities:
+            k = k_for_density(n, rho)
+            for p in PLAN_WORKERS:
+                tree_b = comm_bytes_per_step("gtopk", n, k, p)
+                bal_b = comm_bytes_per_step(
+                    "gtopk", n, k, p, schedule="balanced")
+                rows.append({
+                    "size": label, "n": n, "density": rho, "k": k,
+                    "p": p, "cap": balanced_cap(k, p, n),
+                    "tree_wire_bytes": tree_b,
+                    "balanced_wire_bytes": bal_b,
+                    "bytes_ratio": round(bal_b / max(tree_b, 1), 4),
+                    "tree_ms_model": round(
+                        predict("gtopk", p, n=n, k=k, **model), 4),
+                    "balanced_ms_model": round(
+                        predict("gtopk_balanced", p, n=n, k=k, **model),
+                        4),
+                })
+    return rows
+
 
 def main():
     from gtopkssgd_tpu.utils import enable_compilation_cache
@@ -181,6 +223,10 @@ def main():
         "backend": jax.default_backend(),
         "chain_rounds": CHAIN_ROUNDS,
         "rows": rows,
+        # Comm-planner evidence rows: balanced-vs-tree wire volume and
+        # modeled ms per (size, density, P) — the full grid even under
+        # --quick, since these are model-side (milliseconds to compute).
+        "plan_rows": plan_rows(SIZES, DENSITIES),
     }
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

@@ -241,6 +241,95 @@ def run_codec_smoke(out_dir: str) -> dict:
     return rec
 
 
+def run_plan_smoke(out_dir: str, codec_rec: dict) -> dict:
+    """Balanced-vs-tree comm-planner A/B (the ISSUE-9 tentpole's
+    consumer): two tiny flat-gtopk sub-runs pinned to the Ok-Topk
+    balanced schedule (--comm-plan balanced) at the codec smoke's two
+    densities; the tree arms are REUSED from the codec smoke's fp32
+    sub-runs (same config except the pin, and their auto plan resolves
+    to the tree at this shape), so the A/B costs two runs, not four.
+    Returns the fields the main run logs as ONE "plan" record:
+
+      wire_ratio_rho001/rho01    balanced/tree measured wire_bytes. At
+                                 p=2 the balanced schedule's 2p-1=3
+                                 capped messages cost MORE than the
+                                 tree's single full exchange (~2.25x:
+                                 3*cap/k with cap=ceil(1.5k/2)) — the
+                                 planner's whole point is that this is
+                                 shape-dependent; the crossover at
+                                 p>=8 is pinned model-side in
+                                 tests/test_planner.py and
+                                 benchmarks/merge_bench.py
+      recall_floor_breach        max(0, 0.95 - audited recall) under the
+                                 balanced schedule: exactly 0.0 (the
+                                 capped scatter drops nothing at these
+                                 shapes and repair is exact)
+      ledger_bytes_ratio_balanced  obs/ledger.py modeled-vs-measured
+                                 wire bytes on a balanced sub-run: ~1.0
+                                 means the plan-keyed model explains
+                                 the balanced wire exactly
+
+    The ratios divide structurally deterministic byte counters, so the
+    baseline pins them tight; the breach field is exact."""
+    from gtopkssgd_tpu.obs import ledger, report
+    from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+    tree_bytes = {0.001: codec_rec["wire_bytes_fp32_rho001"],
+                  0.01: codec_rec["wire_bytes_fp32_rho01"]}
+    measured: dict = {}
+    bal_records = None
+    for rho in (0.001, 0.01):
+        sub = os.path.join(
+            out_dir, f"plan_ab_balanced_rho{rho:g}".replace(".", "p"))
+        cfg = TrainConfig(
+            dnn="resnet20", batch_size=4, nworkers=2,
+            compression="gtopk", density=rho, seed=42,
+            max_epochs=1, log_interval=2, eval_batches=1,
+            obs_interval=1, obs_audit_interval=2,
+            comm_plan="balanced", out_dir=sub)
+        with Trainer(cfg) as t:
+            t.train(2)  # audit fires at step 2 (obs_audit_interval)
+        recs, _ = report.load_records(sub)
+        obs = [r for r in recs if r.get("kind") == "obs"]
+        wire = [float(r["wire_bytes"]) for r in obs
+                if isinstance(r.get("wire_bytes"), (int, float))]
+        audited = [float(r["audit_recall"]) for r in obs
+                   if float(r.get("audit_recall", -1.0)) >= 0.0]
+        measured[rho] = {
+            "wire_bytes": sum(wire) / len(wire) if wire else 0.0,
+            "audit_recall": max(audited) if audited else -1.0,
+        }
+        if rho == 0.001:
+            bal_records = recs
+    r001 = measured[0.001]["wire_bytes"] / max(tree_bytes[0.001], 1e-9)
+    r01 = measured[0.01]["wire_bytes"] / max(tree_bytes[0.01], 1e-9)
+    rec = {
+        "schedule": "balanced",
+        "wire_bytes_balanced_rho001": measured[0.001]["wire_bytes"],
+        "wire_bytes_tree_rho001": tree_bytes[0.001],
+        "wire_bytes_balanced_rho01": measured[0.01]["wire_bytes"],
+        "wire_bytes_tree_rho01": tree_bytes[0.01],
+        "wire_ratio_rho001": round(r001, 6),
+        "wire_ratio_rho01": round(r01, 6),
+        "audit_recall_balanced": measured[0.001]["audit_recall"],
+        "recall_floor_breach": round(max(
+            0.0, 0.95 - measured[0.001]["audit_recall"]), 6),
+    }
+    # The ledger audit: the balanced sub-run's achieved wire_bytes
+    # against the plan-keyed comm model (obs/ledger.py reads
+    # comm_plan_schedule from the manifest). Mean ratio ~1.0 IS the
+    # evidence that the (2p-1)*wire_set_bytes(cap, n) accounting
+    # matches what the schedule put on the wire.
+    rows = [r for r in ledger.ledger_rows(bal_records or [])
+            if r.get("source") == "wire_bytes"
+            and isinstance(r.get("ratio"), (int, float))]
+    if rows:
+        rec["ledger_bytes_ratio_balanced"] = round(
+            sum(float(r["ratio"]) for r in rows) / len(rows), 6)
+        rec["ledger_rows_balanced"] = len(rows)
+    return rec
+
+
 def run_smoke(out_dir: str) -> str:
     """Train the canonical run; returns the run dir (metrics.jsonl inside).
 
@@ -273,6 +362,7 @@ def run_smoke(out_dir: str) -> str:
     rec_dir = run_recovery_smoke(out_dir)
     twostage_rec = run_twostage_smoke(out_dir)
     codec_rec = run_codec_smoke(out_dir)
+    plan_rec = run_plan_smoke(out_dir, codec_rec)
 
     cfg = smoke_config(out_dir)
     with Trainer(cfg) as t:
@@ -312,6 +402,13 @@ def run_smoke(out_dir: str) -> str:
         # floor under the lossy codec, and the ledger's modeled-vs-
         # measured bytes ratio.
         t.metrics.log("codec", **codec_rec)
+        # And the comm-planner A/B: balanced-vs-tree measured wire
+        # ratios, the recall floor under the balanced schedule, and the
+        # plan-keyed ledger's modeled-vs-measured bytes ratio. (The
+        # trainer already logged this run's own "plan" decision record,
+        # whose plan_is_default=1.0 the baseline pins — defaults keep
+        # the historical tree wire.)
+        t.metrics.log("plan", **plan_rec)
         # Static-analysis gate: run graftlint in-process over the
         # package + benchmarks against the committed repo baseline and
         # record the counts; the gate pins non_baselined at exactly 0,

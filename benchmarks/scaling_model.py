@@ -53,6 +53,7 @@ if _REPO not in sys.path:
 # model and implementation cannot drift.
 from gtopkssgd_tpu.parallel import tree_rounds as _tree_rounds  # noqa: E402
 from gtopkssgd_tpu.parallel import get_codec as _get_codec  # noqa: E402
+from gtopkssgd_tpu.parallel import balanced_cap as _balanced_cap  # noqa: E402
 
 
 def _ring_allreduce_bytes(n_bytes: int, p: int) -> float:
@@ -165,6 +166,21 @@ def predict(mode: str, p: int, *, n: int, k: int, ici_gbps: float,
         return (ici_rounds * set_bytes / ici_Bps * 1e3
                 + flat_dcn_rounds * (set_bytes / dcn_Bps * 1e3
                                      + dcn_alpha_ms))
+    if wire_mode == "gtopk_balanced":
+        # Ok-Topk split-and-reduce (parallel.collectives
+        # balanced_gtopk_allreduce): p-1 scatter ppermutes + a p-slice
+        # allgather, each moving ONE cap-of-n encoded set — O(k) volume
+        # vs the tree's O(k log p), paid for with O(p) message count.
+        # Link split mirrors allgather's: of each phase's p-1 partner
+        # hops, s-1 stay inside the slice, the rest cross DCN; every
+        # DCN hop pays the fitted per-message alpha (the term that makes
+        # the planner prefer the tree on latency-bound fabrics).
+        cap_bytes = _get_codec(codec).wire_set_bytes(
+            _balanced_cap(k, p, n), n)
+        ici_hops = 2 * (s - 1) + 1   # scatter + gather + own-set share
+        dcn_hops = 2 * (p - s)
+        return (ici_hops * cap_bytes / ici_Bps * 1e3
+                + dcn_hops * (cap_bytes / dcn_Bps * 1e3 + dcn_alpha_ms))
     if wire_mode == "allgather":
         return ((set_bytes * s) / ici_Bps * 1e3
                 + (set_bytes * (p - s)) / dcn_Bps * 1e3
@@ -220,7 +236,8 @@ def main():
                                            "ici_gbps", "dcn_gbps",
                                            "ici_size", "dcn_alpha_ms")}}))
     for p in args.ps:
-        for mode in ("dense", "gtopk", "allgather", "gtopk_hier"):
+        for mode in ("dense", "gtopk", "gtopk_balanced", "allgather",
+                     "gtopk_hier"):
             print(json.dumps(project(mode, p, **kw)))
 
 

@@ -399,12 +399,18 @@ class CodecWireRule:
     ``ship()``), so no collective can silently bypass the wire format
     and break cross-rank bit-identity. Dense payloads (ici psum, the
     dense baseline) are exempt — the codec applies to sparse sets
-    only."""
+    only. Every POSITIONAL operand is scanned, not just the leading
+    one, and ``all_to_all`` is in the collective set: the balanced
+    schedule (and any future plan member the planner makes additive)
+    may pass its payload in a non-leading position or scatter via
+    all_to_all, and a schedule that dodges the codec dodges the whole
+    bit-identity audit."""
 
     name = "codec-wire"
 
     _COLLECTIVES = {"lax.ppermute", "jax.lax.ppermute",
                     "lax.all_gather", "jax.lax.all_gather",
+                    "lax.all_to_all", "jax.lax.all_to_all",
                     "lax.psum", "jax.lax.psum",
                     "lax.psum_scatter", "jax.lax.psum_scatter"}
     _SPARSE_NAME = re.compile(
@@ -425,8 +431,8 @@ class CodecWireRule:
                         continue
                     if not node.args:
                         continue
-                    payload = node.args[0]
-                    names = {n.id for n in ast.walk(payload)
+                    names = {n.id for arg in node.args
+                             for n in ast.walk(arg)
                              if isinstance(n, ast.Name)}
                     if names & sanctioned:
                         continue  # ships codec.encode output

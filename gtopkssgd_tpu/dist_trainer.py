@@ -33,6 +33,34 @@ reference always shipped fp32 values + int32 indices):
                                          audit measured-vs-modeled bytes
                                          with ``report ledger``
 
+Comm-planner flag (parallel.planner — no reference equivalent; the MPI
+reference hand-picked its one tree):
+
+    --comm-plan PLAN                     wire-plan pin. 'auto' (default)
+                                         scores every schedule that
+                                         realizes --compression with
+                                         the alpha-beta model (newest
+                                         dcn_probe alpha_beta_fit when
+                                         present, documented fallback
+                                         constants otherwise) and keeps
+                                         the historical schedule on
+                                         ties, so defaults never change
+                                         the wire. Plan grammar: tree
+                                         (hypercube) | balanced (the
+                                         Ok-Topk split-and-reduce,
+                                         arXiv:2201.07598) for gtopk /
+                                         gtopk_layerwise; allgather,
+                                         hier, dense name their modes'
+                                         single schedule. The decision
+                                         (chosen plan + every
+                                         candidate's score) is the
+                                         'plan' metrics record —
+                                         ``report plan`` prints it —
+                                         and the manifest carries
+                                         comm_plan / comm_plan_schedule
+                                         so the ledger audits the
+                                         schedule that actually ran.
+
 Observability flags (obs subsystem — no reference equivalent; the
 reference's only telemetry was text logs):
 
@@ -159,6 +187,17 @@ def build_argparser() -> argparse.ArgumentParser:
                         "Elias-Fano bitpacked indices; BLOCK defaults "
                         "to 64). Quantization error folds into the "
                         "error-feedback residual")
+    p.add_argument("--comm-plan", default="auto",
+                   help="wire-plan pin (parallel.planner). 'auto' "
+                        "(default) scores every schedule that realizes "
+                        "--compression with the alpha-beta model "
+                        "(dcn_probe fit when present) and keeps the "
+                        "historical schedule on ties; a plan name pins "
+                        "it: tree | balanced (Ok-Topk split-and-reduce) "
+                        "for gtopk/gtopk_layerwise, allgather / hier / "
+                        "dense for their modes. Decision is logged as "
+                        "the 'plan' record (``report plan``) and "
+                        "stamped into the run manifest")
     p.add_argument("--clip-grad-norm", type=float, default=None)
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="optimizer steps per jitted dispatch (lax.scan "
@@ -314,6 +353,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         hier_ici=args.hier_ici,
         topk_method=args.topk_method,
         wire_codec=args.wire_codec,
+        comm_plan=args.comm_plan,
         clip_grad_norm=args.clip_grad_norm,
         nsteps_update=args.nsteps_update,
         steps_per_dispatch=args.steps_per_dispatch,

@@ -26,3 +26,25 @@ LAYERWISE_MODES = ("gtopk_layerwise",)
 ALL_MODES = (DENSE_MODES + GTOPK_MODES + ALLGATHER_MODES + HIER_MODES
              + LAYERWISE_MODES)
 SPARSE_MODES = GTOPK_MODES + ALLGATHER_MODES + HIER_MODES + LAYERWISE_MODES
+
+# Wire-schedule vocabulary (the plan layer, parallel/planner.py). A mode
+# fixes the SEMANTICS (what set is applied, what repair contract holds);
+# a schedule fixes the WIRE ALGORITHM that realizes it. Only the gtopk
+# family has more than one realization today: the hypercube 'tree' vs
+# the Ok-Topk 'balanced' split-and-reduce (arXiv:2201.07598). The other
+# entries name each remaining mode's single historical algorithm so a
+# CommPlan is always fully specified.
+SCHEDULES = ("psum", "tree", "balanced", "allgather")
+
+
+def default_schedule(mode: str) -> str:
+    """The hand-picked historical wire schedule for `mode` — what every
+    run used before the planner existed, and what the planner must keep
+    choosing at defaults (no silent behavior change)."""
+    if mode in DENSE_MODES:
+        return "psum"
+    if mode in ALLGATHER_MODES:
+        return "allgather"
+    if mode in GTOPK_MODES + HIER_MODES + LAYERWISE_MODES:
+        return "tree"
+    raise ValueError(f"unknown mode {mode!r}")

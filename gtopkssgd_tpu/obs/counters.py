@@ -129,6 +129,7 @@ def make_telemetry(
     mode,
     ici_size: int = 1,
     codec="fp32",
+    schedule=None,
     grad_norm_pre,
     grad_norm_post,
     residual_norm,
@@ -138,7 +139,8 @@ def make_telemetry(
 ) -> Dict[str, Array]:
     """Assemble the per-step telemetry dict (all f32 scalars).
 
-    ``n``/``k``/``p``/``mode``/``ici_size``/``codec`` are static
+    ``n``/``k``/``p``/``mode``/``ici_size``/``codec``/``schedule`` (the
+    resolved wire plan's schedule, parallel.planner) are static
     trace-time values; ``wire_bytes`` therefore folds to a constant — the
     model volume for this step's collective from the one shared
     definition (parallel.comm_bytes_per_step), so the metric can never
@@ -155,7 +157,7 @@ def make_telemetry(
         "achieved_density": sent / jnp.float32(max(1, n)),
         "wire_bytes": jnp.float32(
             comm_bytes_per_step(mode, n, k, p, ici_size=ici_size,
-                                codec=codec)
+                                codec=codec, schedule=schedule)
         ),
         "m_k": jnp.asarray(m_k, jnp.float32),
     }

@@ -82,6 +82,30 @@ def _codec_set_bytes(codec: str, k: int, n: int) -> int:
         return 8 * k
 
 
+def _balanced_cap(k: int, p: int, n: int) -> int:
+    """Per-destination capacity of the balanced schedule — the shared
+    definition (parallel.collectives.balanced_cap) when importable, else
+    the same closed form, so a bare-ledger install still models it."""
+    try:
+        from gtopkssgd_tpu.parallel.collectives import balanced_cap
+        return balanced_cap(k, p, n)
+    except Exception:
+        return max(1, min(-(-3 * k // (2 * p)), k, -(-n // p)))
+
+
+def wire_mode_for(mode: str, schedule: Optional[str] = None) -> str:
+    """Comm-model key for (semantic mode, wire schedule): the layerwise
+    mode shares the flat tree's wire, and the 'balanced' schedule maps
+    the gtopk family onto the Ok-Topk model branch. None/'auto'/'tree'
+    keep the mode's historical model — exactly sparse_allreduce's plan
+    dispatch, so the ledger always prices the schedule that actually
+    ran."""
+    wm = "gtopk" if mode == "gtopk_layerwise" else mode
+    if schedule == "balanced" and wm in ("gtopk", "gtopk_hier"):
+        return "gtopk_balanced"
+    return wm
+
+
 def predict_comm_ms(mode: str, p: int, *, n: int, k: int,
                     alpha_ms: float = 0.0,
                     beta_gbps: float = DEFAULT_DCN_GBPS,
@@ -109,6 +133,12 @@ def predict_comm_ms(mode: str, p: int, *, n: int, k: int,
     set_bytes = _codec_set_bytes(codec, k, n)
     if wire_mode == "gtopk":
         return rounds * (set_bytes / beta_Bps * 1e3 + alpha_ms)
+    if wire_mode == "gtopk_balanced":
+        # Ok-Topk schedule: p-1 scatter rounds + p-1 gather hops, each
+        # moving one cap-of-n encoded set over the slow link.
+        cap_bytes = _codec_set_bytes(codec, _balanced_cap(k, p, n), n)
+        msgs = 2 * (p - 1)
+        return msgs * (cap_bytes / beta_Bps * 1e3 + alpha_ms)
     if wire_mode == "allgather":
         return (set_bytes * (p - 1) / beta_Bps * 1e3
                 + (p - 1) * alpha_ms)
@@ -165,8 +195,13 @@ def _manifest_params(manifest: Optional[Mapping[str, Any]]
     if mode == "dense":
         k = n
     codec = manifest.get("wire_codec")
+    # The planner stamps the resolved wire schedule into the manifest
+    # (comm_plan_schedule; comm_plan is the plan NAME, kept for humans).
+    # Pre-planner runs have neither -> None -> historical model.
+    schedule = manifest.get("comm_plan_schedule")
     return {"mode": str(mode), "p": p, "n": n, "k": k,
-            "codec": str(codec) if codec else "fp32"}
+            "codec": str(codec) if codec else "fp32",
+            "schedule": str(schedule) if schedule else None}
 
 
 def ledger_rows(records: Sequence[Mapping[str, Any]],
@@ -219,14 +254,16 @@ def ledger_rows(records: Sequence[Mapping[str, Any]],
         else:
             ici_size = 1
 
+    wm = wire_mode_for(params["mode"], params.get("schedule"))
     predicted_ms = predict_comm_ms(
-        params["mode"], params["p"], n=params["n"], k=params["k"],
+        wm, params["p"], n=params["n"], k=params["k"],
         alpha_ms=alpha_ms, beta_gbps=beta_gbps, ici_gbps=ici_gbps,
         ici_size=ici_size, codec=params["codec"])
 
     base = {
         "mode": params["mode"], "p": params["p"],
         "n": params["n"], "k": params["k"], "codec": params["codec"],
+        "schedule": params.get("schedule"),
         "alpha_ms": round(alpha_ms, 6), "beta_gbps": round(beta_gbps, 6),
         "ici_size": ici_size, "fit_source": fit_source,
         "predicted_comm_ms": round(predicted_ms, 6),
@@ -260,11 +297,15 @@ def ledger_rows(records: Sequence[Mapping[str, Any]],
             # timing — the ratio checks volume accounting, the attr rows
             # check time.
             p, nn, k = params["p"], params["n"], params["k"]
-            wm = ("gtopk" if params["mode"] == "gtopk_layerwise"
-                  else params["mode"])
             set_bytes = _codec_set_bytes(params["codec"], k, nn)
             if wm == "dense":
                 pred_bytes = 2.0 * (p - 1) / p * 4 * nn if p > 1 else 0.0
+            elif wm == "gtopk_balanced":
+                # comm_bytes_per_step's balanced formula verbatim:
+                # p-1 scatter rounds + a p-slice allgather, one encoded
+                # cap-of-n set each.
+                pred_bytes = max(1, 2 * p - 1) * _codec_set_bytes(
+                    params["codec"], _balanced_cap(k, p, nn), nn)
             elif wm in ("gtopk", "gtopk_hier"):
                 pred_bytes = _tree_rounds_fallback(
                     p if wm == "gtopk"

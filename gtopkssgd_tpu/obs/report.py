@@ -934,6 +934,56 @@ def build_argparser() -> argparse.ArgumentParser:
     return p
 
 
+def run_plan(run: str, json_out: Optional[str] = None) -> int:
+    """``plan`` subcommand: the comm-planner decision record — which
+    wire plan the run chose, why (every candidate's modeled comm_ms and
+    per-step wire bytes), and the alpha-beta inputs the scores used."""
+    try:
+        records, bad = load_records(run)
+    except OSError as e:
+        print(f"cannot read {run}: {e}")
+        return 2
+    if bad:
+        print(f"note: skipped {bad} malformed line(s)")
+    decisions = [r for r in records if r.get("kind") == "plan"
+                 and isinstance(r.get("candidates"), list)]
+    if not decisions:
+        print("plan: no planner decision record (dense or single-device "
+              "runs have no sparse wire to plan; pre-planner runs "
+              "predate the record)")
+        return 1
+    for rec in decisions:
+        pin = rec.get("pin", "auto")
+        how = f"pinned via --comm-plan {pin}" if pin != "auto" else (
+            "auto-selected (cheapest modeled comm_ms; historical "
+            "schedule wins ties)")
+        print(f"plan: {rec.get('plan')} (schedule={rec.get('schedule')}"
+              f", wire_mode={rec.get('wire_mode')}) for mode="
+              f"{rec.get('mode')} — {how}")
+        print(f"inputs: p={rec.get('p')} n={rec.get('n')} k={rec.get('k')}"
+              f" codec={rec.get('codec')} ici_size={rec.get('ici_size')}"
+              f"  alpha_ms={rec.get('alpha_ms')} "
+              f"beta_gbps={rec.get('beta_gbps')} "
+              f"ici_gbps={rec.get('ici_gbps')} "
+              f"(fit: {rec.get('fit_source')})")
+        rows = []
+        for c in rec["candidates"]:
+            mark = "*" if c.get("name") == rec.get("plan") else ""
+            rows.append([f"{c.get('name')}{mark}",
+                         str(c.get('schedule')),
+                         _fmt(c.get('comm_ms')),
+                         _fmt(c.get('wire_bytes'))])
+        print(_table(rows, ["candidate", "schedule", "comm_ms",
+                            "wire_bytes/step"]))
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump({"decisions": decisions}, fh, indent=1,
+                      sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import sys
 
@@ -1019,6 +1069,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         a = ap.parse_args(argv[1:])
         return run_watch(a.targets, interval=a.interval,
                          iterations=a.iterations)
+    if argv and argv[0] == "plan":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report plan",
+            description="Print the comm-planner decision: chosen wire "
+                        "plan, every candidate's modeled score, and the "
+                        "alpha-beta inputs (parallel/planner.py).")
+        ap.add_argument("run", help="run dir or record file")
+        ap.add_argument("--json", dest="json_out", default=None)
+        a = ap.parse_args(argv[1:])
+        return run_plan(a.run, json_out=a.json_out)
     if argv and argv[0] == "ledger":
         ap = argparse.ArgumentParser(
             "gtopkssgd_tpu.obs.report ledger",

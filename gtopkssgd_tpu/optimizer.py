@@ -58,7 +58,8 @@ from gtopkssgd_tpu.ops import (
     topk_abs,
 )
 from gtopkssgd_tpu.parallel import (
-    get_codec, ici_dense_psum, roundtrip_aligned, sparse_allreduce)
+    get_codec, ici_dense_psum, resolve_plan, roundtrip_aligned,
+    sparse_allreduce, validate_pin)
 
 Array = jax.Array
 ScalarOrSchedule = Union[float, Callable[[Array], Array]]
@@ -110,6 +111,7 @@ def gtopk_sgd(
     axis_size: Optional[int] = None,
     hier_ici_size: int = 1,
     wire_codec: str = "fp32",
+    comm_plan: Optional[str] = "auto",
     warmup_dense_steps: int = 0,
     momentum_correction: bool = False,
     telemetry: bool = False,
@@ -314,6 +316,14 @@ def gtopk_sgd(
     # Validate the codec spec at build time (bad --wire-codec fails here,
     # not inside the jitted step); the instance is reused every step.
     codec = get_codec(wire_codec)
+    # Same build-time discipline for the wire plan: a pin that does not
+    # realize this mode fails here. The plan itself is resolved at TRACE
+    # time (resolve_plan below), when the mesh axis size is known — the
+    # planner memoizes per shape, so retracing costs a dict lookup. The
+    # codec's canonical name keys the planner cache (wire_codec may be a
+    # WireCodec instance).
+    comm_plan = validate_pin(comm_plan, mode, ici_size=hier_ici_size)
+    codec_spec = getattr(codec, "name", "fp32")
     inner = optax.chain(
         optax.add_decayed_weights(weight_decay) if weight_decay else optax.identity(),
         # With momentum correction the velocity lives BEFORE the collective
@@ -420,6 +430,11 @@ def gtopk_sgd(
             scale = jnp.minimum(1.0, clip_grad_norm / (gnorm + 1e-6))
             flats = [f * scale for f in flats]
         p = bound_axis_size()
+        # Wire plan for this (mode, mesh, n, k, codec) — chosen by the
+        # topology planner unless pinned; None at p=1 (no wire).
+        plan = (resolve_plan(mode, p, n, kk_total, codec_spec, 1,
+                             comm_plan)
+                if p > 1 else None)
 
         if correction:
             res_in = state.residual["v"]
@@ -527,6 +542,7 @@ def gtopk_sgd(
             gvals, gidx, _ = sparse_allreduce(
                 mode, vals, idx, k=kk_total, n=n,
                 axis_name=axis_name, axis_size=p, codec=codec,
+                plan=plan,
             )
             # Error-feedback repair, split back per leaf: put_back's layout
             # IS the concatenation order, so static [pos:pos+k_l] slices
@@ -618,6 +634,7 @@ def gtopk_sgd(
         if telemetry:
             tel = obs_counters.make_telemetry(
                 n=n, k=kk_total, p=p, mode=mode, codec=codec,
+                schedule=plan.schedule if plan is not None else None,
                 grad_norm_pre=obs_counters.tree_l2(flats),
                 grad_norm_post=obs_counters.tree_l2(dense_fl),
                 residual_norm=obs_counters.tree_l2(res_struct),
@@ -687,6 +704,7 @@ def gtopk_sgd(
                 ici_size=hier_ici_size,
             )
         btel = None
+        plan = None  # dense mode has no sparse wire to plan
         if dense_mode:
             reduced = lax.psum(flat, axis_name) if p > 1 else flat
             dense = reduced / p
@@ -701,6 +719,11 @@ def gtopk_sgd(
                 if audit:
                     btel["recall"] = jnp.float32(-1.0)
         else:
+            # Wire plan for this (mode, mesh, n, k, codec) — chosen by
+            # the topology planner unless pinned; None at p=1 (no wire).
+            plan = (resolve_plan(mode, p, n, compressor.k(n), codec_spec,
+                                 hier_ici_size if hier else 1, comm_plan)
+                    if p > 1 else None)
             if correction:
                 # DGC velocity recursion on the LOCAL (or slice-summed, in
                 # hier mode) gradient; selection reads v + u below.
@@ -820,7 +843,7 @@ def gtopk_sgd(
                         mode, vals, idx, k=compressor.k(n), n=n,
                         axis_name=axis_name, axis_size=p,
                         ici_size=hier_ici_size if hier else 1,
-                        codec=codec,
+                        codec=codec, plan=plan,
                     )
                     if needs_repair:  # gtopk: sparse set + repair
                         residual = compressor.repair(
@@ -897,6 +920,7 @@ def gtopk_sgd(
                 n=n, k=(n if dense_mode else compressor.k(n)), p=p,
                 mode=mode, ici_size=hier_ici_size if hier else 1,
                 codec=codec,
+                schedule=plan.schedule if plan is not None else None,
                 grad_norm_pre=obs_counters.tree_l2(flat),
                 grad_norm_post=obs_counters.tree_l2(dense),
                 residual_norm=obs_counters.tree_l2(res_struct),

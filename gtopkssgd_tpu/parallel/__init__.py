@@ -15,6 +15,8 @@ from gtopkssgd_tpu.parallel.codec import (
     roundtrip_aligned,
 )
 from gtopkssgd_tpu.parallel.collectives import (
+    balanced_cap,
+    balanced_gtopk_allreduce,
     dense_allreduce,
     gtopk_allreduce,
     hier_gtopk_allreduce,
@@ -25,12 +27,22 @@ from gtopkssgd_tpu.parallel.collectives import (
     tree_rounds,
 )
 from gtopkssgd_tpu.parallel.mesh import make_mesh, dp_axis
+from gtopkssgd_tpu.parallel.planner import (
+    CommPlan,
+    PlanDecision,
+    build_decision,
+    candidate_plans,
+    resolve_plan,
+    validate_pin,
+)
 
 __all__ = [
     "CODEC_NAMES",
     "WireCodec",
     "get_codec",
     "roundtrip_aligned",
+    "balanced_cap",
+    "balanced_gtopk_allreduce",
     "dense_allreduce",
     "gtopk_allreduce",
     "hier_gtopk_allreduce",
@@ -41,4 +53,10 @@ __all__ = [
     "tree_rounds",
     "make_mesh",
     "dp_axis",
+    "CommPlan",
+    "PlanDecision",
+    "build_decision",
+    "candidate_plans",
+    "resolve_plan",
+    "validate_pin",
 ]

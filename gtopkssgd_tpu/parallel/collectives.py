@@ -300,6 +300,122 @@ def hier_gtopk_allreduce(
                        codec=codec)
 
 
+def balanced_cap(k: int, p: int, n: int) -> int:
+    """Per-destination wire capacity of the balanced schedule.
+
+    Each rank ships at most `cap` picks to each owner rank per step. A
+    perfectly uniform index distribution lands k/p picks per owner; the
+    3/2 slack absorbs typical skew without giving back the O(k) volume
+    win (p ranks x cap stays ~1.5k vs the tree's k*log2(p)). Clamped to
+    k (a rank never holds more than k picks total) and to the owner's
+    chunk ceil(n/p) (a range cannot receive more distinct indices than
+    it has slots — this also guarantees the owner-side top_k is legal).
+    Picks beyond cap simply never reach their owner; the optimizer's
+    error-feedback repair restores them exactly, same as tree rejects.
+    """
+    cap = -(-3 * k // (2 * p))
+    return max(1, min(cap, k, -(-n // p)))
+
+
+def balanced_gtopk_allreduce(
+    vals: Array,
+    idx: Array,
+    *,
+    k: int,
+    n: int,
+    axis_name: str,
+    axis_size: int,
+    codec="fp32",
+) -> Tuple[Array, Array]:
+    """Ok-Topk-style balanced split-and-reduce sparse allreduce
+    (arXiv:2201.07598) — the O(k) alternative to the O(k log P) tree.
+
+    Rank r OWNS the contiguous index range [r*chunk, (r+1)*chunk) with
+    chunk = ceil(n/p). Three phases:
+
+      1. scatter: p-1 ppermute rounds; in round s every rank ships to
+         rank (r+s) mod p the <= cap largest-|value| of its picks whose
+         indices land in the destination's range (cap = balanced_cap;
+         sets are chunk-balanced through the same codec wire framing as
+         the tree, so each round moves one cap-of-n encoded set).
+         Own-range picks are applied locally without touching the wire.
+      2. reduce: each owner scatter-adds received picks into a dense
+         f32[chunk] accumulator for its range and keeps the top-cap of
+         |sum| as its merged owner set (zero slots -> sentinel n).
+      3. allgather: every rank gathers all p codec-encoded owner sets
+         and reselects the global top-k from the p*cap candidates.
+
+    Determinism: phase-3 input is the identical all_gather output on
+    every rank and owner ranges are disjoint (no cross-rank duplicate
+    indices to merge), so one shared top_k reselect makes all ranks
+    bit-identical — no broadcast round needed. Overflow (capped-out
+    picks) and global-reselect rejects both leave the pick's index out
+    of the returned gidx, so the existing error-feedback repair
+    (compression.TopKCompressor.repair) restores them exactly; no new
+    repair machinery. Like the tree, the result approximates the dense
+    top-k (a low local |value| can be capped out even if globally
+    large); error feedback absorbs the difference.
+    """
+    p = axis_size
+    codec = get_codec(codec)
+    if p == 1:
+        return vals, idx
+    chunk = -(-n // p)
+    cap = balanced_cap(k, p, n)
+    r = lax.axis_index(axis_name)
+    off = r * chunk
+    real = idx < n
+    owner = jnp.minimum(idx // chunk, p - 1)
+
+    def accumulate(acc, pvals, pidx):
+        """Scatter decoded picks into this rank's owned chunk. Indices
+        outside [off, off+chunk) — including the sentinel n, which CAN
+        alias into the last rank's slot arithmetic when n < chunk*p —
+        are parked at the dropped slot `chunk` explicitly."""
+        loc = pidx - off
+        ok = (pidx < n) & (loc >= 0) & (loc < chunk)
+        return acc.at[jnp.where(ok, loc, chunk)].add(
+            jnp.where(ok, pvals, 0.0), mode="drop")
+
+    # phase 1+2: own picks land directly; remote picks ride the wire.
+    acc = accumulate(jnp.zeros((chunk,), jnp.float32),
+                     jnp.where(real & (owner == r), vals, 0.0), idx)
+    for s in range(1, p):
+        dest = (r + s) % p
+        dmask = real & (owner == dest)
+        mag = jnp.where(dmask, jnp.abs(vals), -1.0)
+        _, pos = lax.top_k(mag, cap)
+        sel = jnp.take(mag, pos) >= 0.0
+        svals = jnp.where(sel, jnp.take(vals, pos), 0.0)
+        sidx = jnp.where(sel, jnp.take(idx, pos), n).astype(jnp.int32)
+        wire = codec.encode(svals, sidx, n=n)
+        perm = [(i, (i + s) % p) for i in range(p)]
+        pwire = tuple(lax.ppermute(w, axis_name, perm) for w in wire)
+        pvals, pidx = codec.decode(pwire, k=cap, n=n)
+        acc = accumulate(acc, pvals, pidx)
+
+    # owner set: top-cap of the reduced range (cap <= chunk by clamp).
+    osel_mag, osel_pos = lax.top_k(jnp.abs(acc), cap)
+    keep = osel_mag > 0.0
+    ovals = jnp.where(keep, jnp.take(acc, osel_pos), 0.0)
+    ogidx = jnp.where(keep, osel_pos + off, n).astype(jnp.int32)
+
+    # phase 3: gather encoded owner sets, shared global reselect.
+    gwire = codec.encode(ovals, ogidx, n=n)
+    all_wire = tuple(lax.all_gather(w, axis_name, tiled=False)
+                     for w in gwire)  # each [P, ...]
+    parts = [codec.decode(tuple(w[t] for w in all_wire), k=cap, n=n)
+             for t in range(p)]
+    cvals = jnp.concatenate([v for v, _ in parts])
+    cidx = jnp.concatenate([i for _, i in parts])
+    fmag = jnp.where(cidx < n, jnp.abs(cvals), -1.0)
+    _, fpos = lax.top_k(fmag, k)
+    fkeep = jnp.take(fmag, fpos) > 0.0
+    gvals = jnp.where(fkeep, jnp.take(cvals, fpos), 0.0)
+    gidx = jnp.where(fkeep, jnp.take(cidx, fpos), n).astype(jnp.int32)
+    return gvals, gidx
+
+
 def topk_allgather(
     vals: Array,
     idx: Array,
@@ -352,6 +468,7 @@ def sparse_allreduce(
     axis_size: int,
     ici_size: int = 1,
     codec="fp32",
+    plan=None,
 ) -> Tuple[Array, Array, bool]:
     """Mode dispatch preserving the reference's L2/L1 boundary.
 
@@ -366,8 +483,27 @@ def sparse_allreduce(
                         repair because every local pick is applied).
     This is the one place the return shape differs across modes; the
     distributed optimizer branches on `gidx is None`.
+
+    ``plan`` selects the WIRE SCHEDULE within the mode's semantics: a
+    parallel.planner.CommPlan (duck-typed — anything with a .schedule
+    attribute), a bare schedule name, or None/'auto' for the mode's
+    historical default. Only the gtopk family has a real choice today:
+    'tree' (hypercube, the default) vs 'balanced' (Ok-Topk split-and-
+    reduce). Both return the repair contract (needs_repair=True), so
+    the optimizer's error feedback is schedule-agnostic.
     """
+    schedule = getattr(plan, "schedule", plan)
     if mode in GTOPK_MODES or mode in LAYERWISE_MODES:
+        if schedule not in (None, "auto", "tree", "balanced"):
+            raise ValueError(
+                f"mode {mode!r} supports schedules 'tree'/'balanced', "
+                f"got {schedule!r}")
+        if schedule == "balanced":
+            gvals, gidx = balanced_gtopk_allreduce(
+                vals, idx, k=k, n=n, axis_name=axis_name,
+                axis_size=axis_size, codec=codec,
+            )
+            return gvals, gidx, True
         # Layer-wise mode changes only the LOCAL selection (per-layer k_l
         # instead of one global top-k); the wire protocol is the same
         # fixed-K (vals, idx) set, so the hypercube runs unchanged.
@@ -392,7 +528,8 @@ def sparse_allreduce(
 
 
 def comm_bytes_per_step(mode: str, n: int, k: int, p: int,
-                        ici_size: int = 1, codec="fp32") -> int:
+                        ici_size: int = 1, codec="fp32",
+                        schedule=None) -> int:
     """Per-device communication volume model (paper §3 complexity table):
     gtopk O(k log P), allgather O(k P), dense O(N). Each sparse round
     ships one codec-encoded k-of-n set (``codec.wire_set_bytes`` —
@@ -405,9 +542,20 @@ def comm_bytes_per_step(mode: str, n: int, k: int, p: int,
     slice (which rides ICI — fast links, usually not the bottleneck the
     model is meant to expose, and always fp32: the codec applies to the
     sparse set only) plus the sparse O(k log(P/ici)) across slices (the
-    DCN hop the hierarchy exists to thin out)."""
+    DCN hop the hierarchy exists to thin out).
+
+    ``schedule`` mirrors sparse_allreduce's plan dispatch: for the gtopk
+    family, 'balanced' models the Ok-Topk schedule — p-1 scatter rounds
+    plus a p-slice allgather, each moving one cap-of-n encoded set —
+    while None/'auto'/'tree' keep the historical tree model. The two
+    formulas share balanced_cap/tree_rounds with the implementation, so
+    the ledger audit measures exactly what the wire ships."""
     set_bytes = get_codec(codec).wire_set_bytes(k, n)
     if mode in GTOPK_MODES or mode in LAYERWISE_MODES:
+        if schedule == "balanced":
+            cap_bytes = get_codec(codec).wire_set_bytes(
+                balanced_cap(k, p, n), n)
+            return cap_bytes * max(1, 2 * p - 1)
         # layerwise: same wire protocol, K differs from rho*N only by the
         # +1-per-tiny-layer rounding of k_l = ceil(rho * n_l).
         return set_bytes * max(1, tree_rounds(p))

@@ -1,0 +1,245 @@
+"""Topology-aware comm planner: wire plans, scored once at startup.
+
+Before this module, the wire algorithm was welded to the compression
+mode: gtopk meant the hypercube tree, allgather meant the DGC union, and
+adding a schedule meant threading a new mode string through every
+dispatch table. The planner splits those concerns. A mode fixes the
+SEMANTICS (what sparse set is applied, what repair contract the
+optimizer gets); a :class:`CommPlan` fixes the WIRE — per-axis
+algorithm, schedule, codec, and ici/dcn split — and is chosen ONCE at
+startup by scoring every semantics-preserving candidate with the same
+alpha-beta model the comm ledger audits against
+(``benchmarks/scaling_model.predict`` via ``obs.ledger.predict_comm_ms``,
+parameterized from a ``dcn_probe`` ``alpha_beta_fit`` artifact when one
+is present, pure alpha-beta fallback otherwise).
+
+Candidate sets are deliberately semantics-preserving: the planner never
+swaps gtopk for allgather behind the user's back — it only picks among
+wire realizations of the mode the user asked for (today: the hypercube
+'tree' vs the Ok-Topk 'balanced' split-and-reduce, arXiv:2201.07598).
+Ties and model-indifferent regimes resolve to the hand-picked historical
+schedule (:func:`gtopkssgd_tpu.modes.default_schedule`), so default runs
+keep their exact pre-planner wire. ``--comm-plan`` pins a plan by name;
+the full decision — chosen plan plus the score of every candidate — is
+logged as a ``"plan"`` metrics record and stamped into the run manifest,
+so every ledger row can be traced back to why its schedule won.
+
+Import discipline: scoring needs obs.ledger, and obs imports parallel —
+so the ledger import is lazy (inside functions), keeping
+``parallel.planner`` importable from ``parallel/__init__`` without a
+cycle. Collectives never import the planner: ``sparse_allreduce`` takes
+the plan duck-typed (anything with ``.schedule``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+from gtopkssgd_tpu.modes import (
+    ALLGATHER_MODES,
+    DENSE_MODES,
+    GTOPK_MODES,
+    HIER_MODES,
+    LAYERWISE_MODES,
+    default_schedule,
+)
+from gtopkssgd_tpu.parallel.collectives import (
+    balanced_cap,
+    comm_bytes_per_step,
+)
+
+# Per-message slow-link latency assumed when NO dcn_probe artifact is
+# available (benchmarks/results/dcn_probe_*proc.json). Deliberately
+# nonzero: the degenerate alpha=0 bandwidth-only model would let any
+# many-small-messages schedule (balanced sends O(p) messages where the
+# tree sends O(log p)) win on volume alone and silently change the wire
+# at defaults. 0.1 ms is a conservative floor for any cross-host fabric;
+# the committed 4-proc probe fit measured ~21.9 ms on loopback-TCP.
+PLANNER_DEFAULT_ALPHA_MS = 0.1
+
+
+@dataclasses.dataclass(frozen=True)
+class CommPlan:
+    """One fully-specified wire realization of a reduction mode.
+
+    ``schedule`` is the slow-axis algorithm (modes.SCHEDULES), ``intra``
+    the ICI-axis phase ('psum' for the hier mode's in-slice dense
+    allreduce, 'none' otherwise), ``codec`` the sparse payload codec
+    spec, ``ici_size`` the ICI-domain width the plan assumes. The name
+    is the plan grammar the ``--comm-plan`` flag speaks.
+    """
+
+    name: str
+    mode: str
+    schedule: str
+    intra: str = "none"
+    codec: str = "fp32"
+    ici_size: int = 1
+
+    @property
+    def wire_mode(self) -> str:
+        """Comm-model key (scaling_model.predict / ledger) this plan
+        prices as — the single mapping shared with the ledger."""
+        from gtopkssgd_tpu.obs.ledger import wire_mode_for
+        return wire_mode_for(self.mode, self.schedule)
+
+
+def _norm_mode(mode: Optional[str]) -> str:
+    return "dense" if mode in DENSE_MODES else str(mode)
+
+
+def candidate_plans(mode: Optional[str], *, codec: str = "fp32",
+                    ici_size: int = 1) -> Tuple[CommPlan, ...]:
+    """Every wire plan that realizes ``mode``'s semantics, historical
+    default FIRST (selection uses a stable min, so the default wins all
+    ties and all model-indifferent regimes)."""
+    m = _norm_mode(mode)
+    if m in DENSE_MODES:
+        return (CommPlan("dense", m, "psum", "none", codec, 1),)
+    if m in ALLGATHER_MODES:
+        return (CommPlan("allgather", m, "allgather", "none", codec, 1),)
+    if m in HIER_MODES:
+        # The hier tree already IS a planned ici/dcn split; a balanced
+        # cross-slice variant would need slice-identical owner ranges
+        # and is future work — the plan layer makes it additive.
+        return (CommPlan("hier", m, "tree", "psum", codec,
+                         max(1, ici_size)),)
+    if m in GTOPK_MODES or m in LAYERWISE_MODES:
+        return (CommPlan("tree", m, "tree", "none", codec, 1),
+                CommPlan("balanced", m, "balanced", "none", codec, 1))
+    raise ValueError(f"unknown mode {mode!r}")
+
+
+def validate_pin(pin: Optional[str], mode: Optional[str], *,
+                 ici_size: int = 1) -> str:
+    """Normalize and check a ``--comm-plan`` pin against the mode's
+    candidate set at config time — a typo'd or incompatible pin fails
+    at startup, not three imports deep into the first traced step."""
+    pin = "auto" if pin in (None, "", "auto") else str(pin)
+    if pin == "auto":
+        return pin
+    names = [c.name for c in candidate_plans(mode, ici_size=ici_size)]
+    if pin not in names:
+        raise ValueError(
+            f"--comm-plan {pin!r} does not realize mode {mode!r}; "
+            f"valid plans here: auto, {', '.join(names)}")
+    return pin
+
+
+def planner_inputs(probe_dir: Optional[str] = None) -> Dict[str, Any]:
+    """The alpha-beta constants the planner scores with, plus where they
+    came from: the newest dcn_probe ``alpha_beta_fit`` artifact when one
+    exists, else documented fallback defaults (PLANNER_DEFAULT_ALPHA_MS
+    + the scaling model's DCN bandwidth)."""
+    from gtopkssgd_tpu.obs import ledger
+    fit = ledger.load_alpha_beta(search_dir=probe_dir)
+    if fit is not None:
+        return {"alpha_ms": fit["alpha_ms"],
+                "beta_gbps": fit["beta_gbps"],
+                "ici_gbps": ledger.DEFAULT_ICI_GBPS,
+                "fit_source": fit["source"]}
+    return {"alpha_ms": PLANNER_DEFAULT_ALPHA_MS,
+            "beta_gbps": ledger.DEFAULT_DCN_GBPS,
+            "ici_gbps": ledger.DEFAULT_ICI_GBPS,
+            "fit_source": "fallback-defaults"}
+
+
+def score_plan(plan: CommPlan, p: int, *, n: int, k: int,
+               alpha_ms: float, beta_gbps: float,
+               ici_gbps: float) -> float:
+    """Predicted comm_ms of one candidate — scaling_model.predict when
+    benchmarks/ is present, the ledger's pure alpha-beta model
+    otherwise. The same number the ledger later audits against measured
+    T_comm, so a plan decision is always reconcilable post-hoc."""
+    from gtopkssgd_tpu.obs.ledger import predict_comm_ms
+    return predict_comm_ms(
+        plan.wire_mode, p, n=n, k=k, alpha_ms=alpha_ms,
+        beta_gbps=beta_gbps, ici_gbps=ici_gbps,
+        ici_size=plan.ici_size, codec=plan.codec)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanDecision:
+    """A resolved plan plus the evidence: every candidate's score and
+    the model inputs used. ``record()`` is the flat dict the trainer
+    logs as the ``"plan"`` metrics record."""
+
+    plan: CommPlan
+    candidates: Tuple[Dict[str, Any], ...]
+    inputs: Dict[str, Any]
+    pin: str = "auto"
+
+    def record(self) -> Dict[str, Any]:
+        historical = default_schedule(self.plan.mode)
+        return {
+            "plan": self.plan.name,
+            "schedule": self.plan.schedule,
+            "wire_mode": self.plan.wire_mode,
+            "mode": self.plan.mode,
+            "intra": self.plan.intra,
+            "pin": self.pin,
+            # numeric so the gate smoke can pin "defaults kept the
+            # historical wire" as a baseline check
+            "plan_is_default": float(self.plan.schedule == historical),
+            "candidates": list(self.candidates),
+            **{key: self.inputs[key] for key in sorted(self.inputs)},
+        }
+
+
+def build_decision(mode: Optional[str], *, p: int, n: int, k: int,
+                   codec: str = "fp32", ici_size: int = 1,
+                   pin: Optional[str] = "auto",
+                   probe_dir: Optional[str] = None,
+                   alpha_ms: Optional[float] = None,
+                   beta_gbps: Optional[float] = None,
+                   ici_gbps: Optional[float] = None) -> PlanDecision:
+    """Score every candidate plan for (mode, mesh, n, k, codec) and pick
+    one: the pinned plan when ``pin`` names one, else the cheapest under
+    the model (stable min — the historical default wins ties). Explicit
+    alpha/beta/ici arguments override the probe-artifact lookup (tests,
+    what-if scoring)."""
+    pin = validate_pin(pin, mode, ici_size=ici_size)
+    inputs = planner_inputs(probe_dir)
+    if alpha_ms is not None:
+        inputs["alpha_ms"], inputs["fit_source"] = float(alpha_ms), "arg"
+    if beta_gbps is not None:
+        inputs["beta_gbps"], inputs["fit_source"] = float(beta_gbps), "arg"
+    if ici_gbps is not None:
+        inputs["ici_gbps"] = float(ici_gbps)
+    cands = candidate_plans(mode, codec=codec, ici_size=ici_size)
+    scored: List[Dict[str, Any]] = []
+    for cand in cands:
+        ms = score_plan(cand, p, n=n, k=k, alpha_ms=inputs["alpha_ms"],
+                        beta_gbps=inputs["beta_gbps"],
+                        ici_gbps=inputs["ici_gbps"])
+        scored.append({
+            "name": cand.name, "schedule": cand.schedule,
+            "wire_mode": cand.wire_mode, "comm_ms": round(ms, 6),
+            "wire_bytes": comm_bytes_per_step(
+                cand.mode, n, k, p, ici_size=cand.ici_size,
+                codec=cand.codec, schedule=cand.schedule),
+        })
+    if pin != "auto":
+        chosen = next(c for c in cands if c.name == pin)
+    else:
+        chosen = cands[min(range(len(cands)),
+                           key=lambda i: scored[i]["comm_ms"])]
+    inputs = {**inputs, "p": p, "n": n, "k": k, "codec": str(codec),
+              "ici_size": ici_size}
+    return PlanDecision(plan=chosen, candidates=tuple(scored),
+                        inputs=inputs, pin=pin)
+
+
+@functools.lru_cache(maxsize=None)
+def resolve_plan(mode: Optional[str], p: int, n: int, k: int,
+                 codec: str = "fp32", ici_size: int = 1,
+                 pin: Optional[str] = "auto",
+                 probe_dir: Optional[str] = None) -> CommPlan:
+    """The optimizer's trace-time entry point: (mode, mesh, n, k, codec,
+    pin) -> CommPlan, memoized — the decision is made once per distinct
+    shape, never per step, and retracing costs a dict lookup."""
+    return build_decision(mode, p=p, n=n, k=k, codec=codec,
+                          ici_size=ici_size, pin=pin,
+                          probe_dir=probe_dir).plan

@@ -88,6 +88,11 @@ class TrainConfig:
     wire_codec: str = "fp32"       # on-wire sparse-set encoding for every
                                    # exchange round (parallel.codec grammar:
                                    # fp32 | int8[:BLOCK] | fp8[:BLOCK])
+    comm_plan: str = "auto"        # wire-plan pin (parallel.planner):
+                                   # 'auto' scores candidates with the
+                                   # alpha-beta model; a plan name (tree |
+                                   # balanced | allgather | hier | dense)
+                                   # pins the schedule for this mode
     clip_grad_norm: Optional[float] = None  # default: LSTMs clip (ref §3.4)
     nsteps_update: int = 1
     warmup_epochs: int = 0         # linear LR ramp over the first N epochs
@@ -439,6 +444,26 @@ class Trainer:
         # flatten order — the same order the optimizer's segment map uses.
         self._layer_names = (
             layer_names(self.state.params) if cfg.obs_layers else ())
+        # Wire-plan decision (parallel.planner): resolved once here with
+        # the same inputs the optimizer's trace-time resolve_plan sees,
+        # logged as the "plan" record (chosen plan + every candidate's
+        # score) and stamped into the manifest so the ledger prices the
+        # schedule that actually ran. Dense / single-device runs have no
+        # sparse wire to plan.
+        self._plan_decision = None
+        if cfg.compression not in (None, "none", "dense") and self.p > 1:
+            from gtopkssgd_tpu.parallel import build_decision
+            k = max(1, int(np.ceil(cfg.density * self.num_params)))
+            self._plan_decision = build_decision(
+                cfg.compression, p=self.p, n=self.num_params, k=k,
+                codec=cfg.wire_codec, ici_size=cfg.hier_ici,
+                pin=cfg.comm_plan)
+        plan_extra = {}
+        if self._plan_decision is not None:
+            d = self._plan_decision
+            plan_extra = {"comm_plan": d.plan.name,
+                          "comm_plan_schedule": d.plan.schedule,
+                          "comm_plan_pin": d.pin}
         # Run-manifest header: first record of every metrics file, so
         # each is self-describing (config hash + resolved headline flags,
         # mesh, jax/backend versions, git sha). In sharded multi-process
@@ -446,7 +471,10 @@ class Trainer:
         # fleet merger validates before aligning shards.
         self.metrics.log("manifest", flush=True, **run_manifest(
             cfg, mesh=self.mesh, num_params=self.num_params,
-            steps_per_epoch=self.steps_per_epoch))
+            steps_per_epoch=self.steps_per_epoch, **plan_extra))
+        if self._plan_decision is not None:
+            self.metrics.log("plan", flush=True,
+                             **self._plan_decision.record())
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
         # Degrade fallback (recover-policy "degrade"): the sparse step
@@ -497,6 +525,7 @@ class Trainer:
             density=cfg.density,
             topk_method=cfg.topk_method,
             wire_codec=cfg.wire_codec,
+            comm_plan=cfg.comm_plan,
             clip_grad_norm=cfg.clip_grad_norm,
             axis_name="dp" if self.p > 1 else None,
             hier_ici_size=cfg.hier_ici,

@@ -60,6 +60,9 @@ KINDS = frozenset({
     "lint",        # graftlint summary row (gate smoke): finding counts
                    # from python -m gtopkssgd_tpu.analysis, gated at 0
                    # non-baselined findings
+    "plan",        # comm-planner decision (parallel/planner.py): chosen
+                   # wire plan + every candidate's modeled score; also
+                   # the gate smoke's balanced-vs-tree A/B evidence row
 })
 
 _SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")

@@ -296,6 +296,30 @@ def test_codec_wire_encoded_and_dense_pass(tmp_path):
     assert _run(root, "codec-wire").findings == []
 
 
+def test_codec_wire_all_to_all_and_nonleading_payload(tmp_path):
+    # The balanced-schedule extension: all_to_all is a wire collective
+    # too, and a sparse payload in ANY positional slot (not just the
+    # leading one) must be codec-mediated.
+    root = _tree(tmp_path, {"pkg/parallel/coll.py": """\
+        from jax import lax
+
+        def bad_a2a(vals, axis_name):
+            return lax.all_to_all(vals, axis_name, 0, 0)
+
+        def bad_tail(mask, vals, axis_name):
+            return lax.ppermute(mask * vals, axis_name, [(0, 1)])
+
+        def good_a2a(vals, idx, axis_name, codec, n):
+            wire = codec.encode(vals, idx, n=n)
+            swire = tuple(lax.all_to_all(w, axis_name, 0, 0)
+                          for w in wire)
+            return codec.decode(swire, k=2, n=n)
+    """})
+    res = _run(root, "codec-wire")
+    assert sorted(f.symbol for f in res.findings) == [
+        "bad_a2a", "bad_tail"]
+
+
 def test_codec_wire_scoped_to_parallel(tmp_path):
     root = _tree(tmp_path, {"pkg/other.py": """\
         from jax import lax
