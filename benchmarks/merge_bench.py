@@ -183,6 +183,64 @@ def plan_rows(sizes: dict, densities) -> list:
     return rows
 
 
+BUCKET_ALPHAS_MS = (0.1, 5.0, 22.0)   # ICI-class, mid, measured-DCN latency
+BUCKET_MODELS = ("resnet50", "vgg16")
+BUCKET_DENSITY = 0.001
+
+
+def _model_leaf_sizes(dnn: str):
+    """Param leaf sizes in jax.tree flatten order — the exact axis the
+    optimizer's bucket plan partitions — via eval_shape (no weights are
+    materialized, so this is milliseconds even for the 25M-param net)."""
+    import jax.numpy as jnp
+
+    from gtopkssgd_tpu.models import get_model
+    model, spec = get_model(dnn)
+    x = jnp.zeros((1,) + spec.example_shape, jnp.float32)
+    var = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), x, train=False))
+    return tuple(int(l.size) for l in jax.tree_util.tree_leaves(var["params"]))
+
+
+def bucket_rows(p: int = 32) -> list:
+    """Bucketing evidence rows (parallel.bucketing): per-leaf vs
+    DP-bucketed modeled comm ms across the alpha sweep. One row per
+    (model, alpha): the DP's chosen B, its modeled ms, and the two
+    degenerate partitions (B=1 single merge, B=L per-leaf) — showing the
+    latency-bound regime (alpha=22 ms DCN: B collapses toward 1, per-leaf
+    pays L*alpha) and the bandwidth-bound one (alpha=0.1 ms ICI-class:
+    larger B wins back bucket-local index bits)."""
+    from gtopkssgd_tpu.parallel import bucketing, plan_buckets
+    from gtopkssgd_tpu.parallel.planner import planner_inputs
+
+    beta = planner_inputs()["beta_gbps"]
+    rows = []
+    for dnn in BUCKET_MODELS:
+        sizes = _model_leaf_sizes(dnn)
+        for alpha in BUCKET_ALPHAS_MS:
+            kw = dict(p=p, codec="fp32", alpha_ms=alpha, beta_gbps=beta)
+
+            def _ms(spec):
+                plan = plan_buckets(sizes, BUCKET_DENSITY,
+                                    buckets=spec, **kw)
+                return plan, bucketing.partition_cost_ms(plan, **kw)
+
+            auto, auto_ms = _ms("auto")
+            _, leaf_ms = _ms("leaf")
+            _, b1_ms = _ms(1)
+            rows.append({
+                "model": dnn, "n_leaves": len(sizes), "n": sum(sizes),
+                "density": BUCKET_DENSITY, "p": p,
+                "alpha_ms": alpha, "beta_gbps": beta,
+                "auto_n_buckets": auto.n_buckets,
+                "auto_ms_model": round(auto_ms, 4),
+                "b1_ms_model": round(b1_ms, 4),
+                "leaf_ms_model": round(leaf_ms, 4),
+                "leaf_over_auto": round(leaf_ms / max(auto_ms, 1e-9), 4),
+            })
+    return rows
+
+
 def main():
     from gtopkssgd_tpu.utils import enable_compilation_cache
 
@@ -227,6 +285,9 @@ def main():
         # modeled ms per (size, density, P) — the full grid even under
         # --quick, since these are model-side (milliseconds to compute).
         "plan_rows": plan_rows(SIZES, DENSITIES),
+        # Bucketing evidence rows: per-leaf vs DP-bucketed modeled comm
+        # ms across the alpha sweep — also model-side, full grid always.
+        "bucket_rows": bucket_rows(),
     }
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

@@ -330,6 +330,97 @@ def run_plan_smoke(out_dir: str, codec_rec: dict) -> dict:
     return rec
 
 
+def run_bucket_smoke(out_dir: str) -> dict:
+    """Bucketed-vs-per-leaf layerwise A/B (the bucketing tentpole's
+    consumer): two tiny gtopk_layerwise sub-runs at the DCN-regime
+    density (rho=0.001, p=2, 2 steps) differing ONLY in --buckets —
+    'leaf' (one merge per param leaf, B=L) vs 'auto' (the alpha-beta DP,
+    which at the committed ~22 ms alpha collapses resnet20's 65 leaves
+    to B=1). Returns the fields the main run logs as ONE "bucket"
+    record so the drift gate can pin the PR's acceptance numbers:
+
+      collective_ratio           leaf/auto per-step sparse-merge count
+                                 from the collective_count telemetry
+                                 (structural: L=65 over B=1). The
+                                 acceptance bar is >=3x fewer merges
+      collective_floor_breach    max(0, 3 - ratio): one-sided ">=3x"
+                                 evidence, exactly 0.0
+      audit_recall_bucketed      audited recall on the bucketed arm
+                                 (per-bucket exact top-k audit), floor
+                                 0.95
+      ledger_bytes_ratio_bucketed  obs/ledger.py modeled-vs-measured
+                                 wire bytes on the bucketed arm: ~1.0
+                                 means the bucket-summed model explains
+                                 the achieved bytes
+
+    Counts and byte counters are structural (fixed by the leaf shapes
+    and the DP's boundaries), so the baseline pins them tight."""
+    from gtopkssgd_tpu.obs import ledger, report
+    from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+    measured: dict = {}
+    auto_records = None
+    for buckets in ("leaf", "auto"):
+        sub = os.path.join(out_dir, f"bucket_ab_{buckets}")
+        cfg = TrainConfig(
+            dnn="resnet20", batch_size=4, nworkers=2,
+            compression="gtopk_layerwise", density=0.001, seed=42,
+            max_epochs=1, log_interval=2, eval_batches=1,
+            obs_interval=1, obs_audit_interval=2,
+            buckets=buckets, out_dir=sub)
+        with Trainer(cfg) as t:
+            t.train(2)  # audit fires at step 2 (obs_audit_interval)
+            n_buckets = t._bucket_plan.n_buckets
+        recs, _ = report.load_records(sub)
+        obs = [r for r in recs if r.get("kind") == "obs"]
+        coll = [float(r["collective_count"]) for r in obs
+                if isinstance(r.get("collective_count"), (int, float))]
+        wire = [float(r["wire_bytes"]) for r in obs
+                if isinstance(r.get("wire_bytes"), (int, float))]
+        audited = [float(r["audit_recall"]) for r in obs
+                   if float(r.get("audit_recall", -1.0)) >= 0.0]
+        measured[buckets] = {
+            "n_buckets": n_buckets,
+            "collective_count": max(coll) if coll else 0.0,
+            "wire_bytes": sum(wire) / len(wire) if wire else 0.0,
+            "audit_recall": max(audited) if audited else -1.0,
+        }
+        if buckets == "auto":
+            auto_records = recs
+    ratio = (measured["leaf"]["collective_count"]
+             / max(measured["auto"]["collective_count"], 1e-9))
+    wire_ratio = (measured["auto"]["wire_bytes"]
+                  / max(measured["leaf"]["wire_bytes"], 1e-9))
+    rec = {
+        "buckets": "auto",
+        "n_buckets_leaf": measured["leaf"]["n_buckets"],
+        "n_buckets_auto": measured["auto"]["n_buckets"],
+        "collective_count_leaf": measured["leaf"]["collective_count"],
+        "collective_count_auto": measured["auto"]["collective_count"],
+        "collective_ratio": round(ratio, 4),
+        "collective_floor_breach": round(max(0.0, 3.0 - ratio), 6),
+        "wire_bytes_leaf": measured["leaf"]["wire_bytes"],
+        "wire_bytes_auto": measured["auto"]["wire_bytes"],
+        "wire_ratio_auto_leaf": round(wire_ratio, 6),
+        "audit_recall_bucketed": measured["auto"]["audit_recall"],
+        "recall_floor_breach": round(max(
+            0.0, 0.95 - measured["auto"]["audit_recall"]), 6),
+    }
+    # The ledger audit: the bucketed arm's achieved wire_bytes against
+    # the bucket-summed comm model (obs/ledger.py reads the manifest's
+    # bucket_sizes/bucket_ks and prices each bucket over its OWN local
+    # index space). Mean ratio ~1.0 IS the evidence that the bucketed
+    # wire accounting matches what the schedule put on the wire.
+    rows = [r for r in ledger.ledger_rows(auto_records or [])
+            if r.get("source") == "wire_bytes"
+            and isinstance(r.get("ratio"), (int, float))]
+    if rows:
+        rec["ledger_bytes_ratio_bucketed"] = round(
+            sum(float(r["ratio"]) for r in rows) / len(rows), 6)
+        rec["ledger_rows_bucketed"] = len(rows)
+    return rec
+
+
 def run_smoke(out_dir: str) -> str:
     """Train the canonical run; returns the run dir (metrics.jsonl inside).
 
@@ -363,6 +454,7 @@ def run_smoke(out_dir: str) -> str:
     twostage_rec = run_twostage_smoke(out_dir)
     codec_rec = run_codec_smoke(out_dir)
     plan_rec = run_plan_smoke(out_dir, codec_rec)
+    bucket_rec = run_bucket_smoke(out_dir)
 
     cfg = smoke_config(out_dir)
     with Trainer(cfg) as t:
@@ -409,6 +501,11 @@ def run_smoke(out_dir: str) -> str:
         # whose plan_is_default=1.0 the baseline pins — defaults keep
         # the historical tree wire.)
         t.metrics.log("plan", **plan_rec)
+        # And the bucketing A/B: leaf-vs-auto collective counts (the
+        # one-sided >=3x fewer-merges evidence), the audited recall
+        # floor on the bucketed arm, and the bucket-summed ledger's
+        # modeled-vs-measured bytes ratio.
+        t.metrics.log("bucket", **bucket_rec)
         # Static-analysis gate: run graftlint in-process over the
         # package + benchmarks against the committed repo baseline and
         # record the counts; the gate pins non_baselined at exactly 0,

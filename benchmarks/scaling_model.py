@@ -108,7 +108,8 @@ def project(mode: str, p: int, *, n: int, k: int, compute_ms: float,
 
 def predict(mode: str, p: int, *, n: int, k: int, ici_gbps: float,
             dcn_gbps: float, ici_size: int,
-            dcn_alpha_ms: float = 0.0, codec: str = "fp32") -> float:
+            dcn_alpha_ms: float = 0.0, codec: str = "fp32",
+            buckets=None) -> float:
     """Predicted comm_ms alone — the comm-model ledger's entry point
     (obs/ledger.py joins this against measured per-step T_comm). Same
     model as project(), with the compute/overhead/throughput bookkeeping
@@ -122,7 +123,20 @@ def predict(mode: str, p: int, *, n: int, k: int, ici_gbps: float,
     block scales + Elias-Fano bitpacked indices; fp32 identity = the
     historical 8 bytes/element). Every sparse exchange — ICI and DCN
     rounds alike — ships codec bytes, because the tree encodes every
-    round; the hier mode's dense intra-slice psum stays 4n fp32."""
+    round; the hier mode's dense intra-slice psum stays 4n fp32.
+
+    ``buckets`` — ((n_b, k_b), ...) from a layerwise BucketPlan
+    (gtopkssgd_tpu.parallel.bucketing) — prices the bucketed wire as B
+    independent merges of this mode, each over its bucket-local index
+    space, summed. That is exactly what the bucketed optimizer path
+    issues, so the ledger's bucketed rows reconcile against the same
+    per-merge model as everything else."""
+    if buckets:
+        return sum(
+            predict(mode, p, n=int(n_b), k=int(k_b), ici_gbps=ici_gbps,
+                    dcn_gbps=dcn_gbps, ici_size=ici_size,
+                    dcn_alpha_ms=dcn_alpha_ms, codec=codec)
+            for n_b, k_b in buckets)
     # The layerwise mode's wire cost IS gtopk's: the layerwise K differs
     # from ceil(rho*N) only by the +1-per-tiny-leaf ceil rounding (<1%
     # for ResNet-50 at rho=1e-3).

@@ -394,17 +394,23 @@ def _int_literal(node: ast.AST) -> Optional[int]:
 # --------------------------------------------------------------------------
 
 class CodecWireRule:
-    """Every sparse (vals, idx) exchange in ``parallel/`` must flow
-    through the wire codec (``codec.encode`` / the merge tree's
-    ``ship()``), so no collective can silently bypass the wire format
-    and break cross-rank bit-identity. Dense payloads (ici psum, the
-    dense baseline) are exempt — the codec applies to sparse sets
-    only. Every POSITIONAL operand is scanned, not just the leading
-    one, and ``all_to_all`` is in the collective set: the balanced
-    schedule (and any future plan member the planner makes additive)
-    may pass its payload in a non-leading position or scatter via
-    all_to_all, and a schedule that dodges the codec dodges the whole
-    bit-identity audit."""
+    """Every sparse (vals, idx) exchange in ``parallel/`` — and in
+    ``optimizer.py``, where the bucketed layerwise path concatenates
+    each bucket's leaves and merges them — must flow through the wire
+    codec (``codec.encode`` / the merge tree's ``ship()`` /
+    ``sparse_allreduce``, whose internals are themselves scanned), so
+    no collective can silently bypass the wire format and break
+    cross-rank bit-identity. Dense payloads (ici psum, the dense
+    baseline, grad-norm pmeans) are exempt — the codec applies to
+    sparse sets only. Every POSITIONAL operand is scanned, not just
+    the leading one, and ``all_to_all`` is in the collective set: the
+    balanced schedule (and any future plan member the planner makes
+    additive) may pass its payload in a non-leading position or
+    scatter via all_to_all, and a schedule that dodges the codec
+    dodges the whole bit-identity audit. The sparse-name pattern also
+    matches the bucketed path's per-bucket buffers (``vals_b``,
+    ``idx_b``, plural ``_list`` forms), so a future bucket-concat
+    exchange shipped raw is flagged the same as a flat one."""
 
     name = "codec-wire"
 
@@ -414,12 +420,13 @@ class CodecWireRule:
                     "lax.psum", "jax.lax.psum",
                     "lax.psum_scatter", "jax.lax.psum_scatter"}
     _SPARSE_NAME = re.compile(
-        r"(^|_)(vals|idx|indices|values)$", re.IGNORECASE)
+        r"(^|_)(vals|idx|indices|values)(_b|_list)?$", re.IGNORECASE)
+    _SCANNED = ("parallel/", "optimizer.py")
 
     def run(self, files: Sequence[SourceFile]) -> List[Finding]:
         findings: List[Finding] = []
         for sf in files:
-            if "parallel/" not in sf.rel:
+            if not any(part in sf.rel for part in self._SCANNED):
                 continue
             m = ModuleInfo(sf)
             for fi in m.funcs:

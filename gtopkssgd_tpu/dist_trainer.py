@@ -61,6 +61,34 @@ reference hand-picked its one tree):
                                          so the ledger audits the
                                          schedule that actually ran.
 
+Bucketing flag (parallel.bucketing — no reference equivalent; the MPI
+reference merged layer-by-layer with no cost model):
+
+    --buckets SPEC                       gtopk_layerwise gradient
+                                         bucketing. Grammar: concat
+                                         (default — historical wire:
+                                         per-leaf selection, ONE
+                                         concatenated merge) | leaf
+                                         (one merge per param leaf) |
+                                         an int B | auto. B/auto
+                                         partition the leaves into
+                                         contiguous byte-balanced
+                                         buckets by an exact DP over
+                                         the alpha-beta model (cost
+                                         B*alpha + wire_bytes/beta;
+                                         'auto' also picks B), then run
+                                         one fused two-stage selection
+                                         and one codec-framed merge per
+                                         bucket, scattering update and
+                                         error-feedback residual back
+                                         to the leaves. Boundaries are
+                                         stamped into the manifest
+                                         (bucket_boundaries/_sizes/_ks)
+                                         and logged as the 'bucket'
+                                         record; ``report plan`` prints
+                                         them with modeled ms for
+                                         B in {1, chosen, L}.
+
 Observability flags (obs subsystem — no reference equivalent; the
 reference's only telemetry was text logs):
 
@@ -198,6 +226,19 @@ def build_argparser() -> argparse.ArgumentParser:
                         "dense for their modes. Decision is logged as "
                         "the 'plan' record (``report plan``) and "
                         "stamped into the run manifest")
+    p.add_argument("--buckets", default="concat",
+                   help="gtopk_layerwise only: gradient bucketing "
+                        "(parallel.bucketing). 'concat' (default) keeps "
+                        "the historical wire — per-leaf selection, one "
+                        "concatenated merge; 'leaf' runs one merge per "
+                        "param leaf; an int B or 'auto' partitions the "
+                        "leaves into contiguous byte-balanced buckets "
+                        "('auto' picks B itself) by an exact alpha-beta "
+                        "DP — cost B*alpha + wire_bytes/beta — and runs "
+                        "one fused selection + one codec-framed merge "
+                        "per bucket. Boundaries are stamped into the "
+                        "manifest and logged as the 'bucket' record "
+                        "(``report plan`` prints them)")
     p.add_argument("--clip-grad-norm", type=float, default=None)
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="optimizer steps per jitted dispatch (lax.scan "
@@ -354,6 +395,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         topk_method=args.topk_method,
         wire_codec=args.wire_codec,
         comm_plan=args.comm_plan,
+        buckets=args.buckets,
         clip_grad_norm=args.clip_grad_norm,
         nsteps_update=args.nsteps_update,
         steps_per_dispatch=args.steps_per_dispatch,

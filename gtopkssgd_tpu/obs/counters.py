@@ -51,6 +51,13 @@ TELEMETRY_FIELDS = (
     "achieved_density",
     "wire_bytes",
     "m_k",
+    # Wire-level collective launches per optimizer step (f32 of a static
+    # count, like wire_bytes): 0 at p=1, 1 for every single-merge wire,
+    # B for the bucketed layerwise path, 2 for the hier mode's two
+    # levels. The alpha side of the alpha-beta ledger: each launch pays
+    # the per-collective latency that the bucketing DP optimizes, so the
+    # bucket gate pins its >=3x merge-count reduction on this counter.
+    "collective_count",
 )
 
 # Per-layer counter set (telemetry_layers=True). The mass-capture ratio
@@ -130,6 +137,7 @@ def make_telemetry(
     ici_size: int = 1,
     codec="fp32",
     schedule=None,
+    buckets=None,
     grad_norm_pre,
     grad_norm_post,
     residual_norm,
@@ -146,8 +154,29 @@ def make_telemetry(
     definition (parallel.comm_bytes_per_step), so the metric can never
     drift from the benchmark's comm model. With a quantized wire codec
     the constant is CODEC bytes (packed values + scales + bitpacked
-    indices), not logical fp32 bytes."""
+    indices), not logical fp32 bytes.
+
+    ``buckets`` — the bucketed layerwise path's ((n_b, k_b), ...) pairs
+    (parallel.bucketing.BucketPlan.pairs) — makes ``wire_bytes`` the sum
+    over the B merges actually issued (each over its bucket-local index
+    space) and sets ``collective_count`` to B. Like wire_bytes, both are
+    static: during a dense warm-up phase they still describe the sparse
+    wire the run switches to."""
     sent = jnp.asarray(sent_elems, jnp.float32)
+    if buckets:
+        wire = sum(
+            comm_bytes_per_step(mode, int(n_b), int(k_b), p,
+                                ici_size=ici_size, codec=codec,
+                                schedule=schedule)
+            for n_b, k_b in buckets)
+        n_coll = len(buckets) if p > 1 else 0
+    else:
+        wire = comm_bytes_per_step(mode, n, k, p, ici_size=ici_size,
+                                   codec=codec, schedule=schedule)
+        if p <= 1:
+            n_coll = 0
+        else:
+            n_coll = 2 if (mode == "gtopk_hier" and ici_size > 1) else 1
     return {
         "grad_norm_pre": jnp.asarray(grad_norm_pre, jnp.float32),
         "grad_norm_post": jnp.asarray(grad_norm_post, jnp.float32),
@@ -155,11 +184,9 @@ def make_telemetry(
         "tau": jnp.asarray(tau, jnp.float32),
         "sent_elems": sent,
         "achieved_density": sent / jnp.float32(max(1, n)),
-        "wire_bytes": jnp.float32(
-            comm_bytes_per_step(mode, n, k, p, ici_size=ici_size,
-                                codec=codec, schedule=schedule)
-        ),
+        "wire_bytes": jnp.float32(wire),
         "m_k": jnp.asarray(m_k, jnp.float32),
+        "collective_count": jnp.float32(n_coll),
     }
 
 
@@ -349,6 +376,34 @@ def leafwise_sparse_selection_stats(
         "tau": jnp.stack(taus),
         "m_k": sel_sq / jnp.maximum(acc_sq, _MASS_EPS),
     }, whole
+
+
+def bucketed_sparse_selection_stats(
+    accs: Sequence[Array], vals_list: Sequence[Array],
+    idx_list: Sequence[Array], leaf_sizes: Sequence[int],
+    boundaries: Sequence[int],
+) -> Tuple[Dict[str, Array], Array]:
+    """Per-LEAF stats recovered from bucket-concatenated selections.
+
+    The bucketed layerwise path selects per BUCKET (one (vals, idx) set
+    in each bucket's local index space), but --obs-layers reports per
+    leaf. Leaf identity inside a bucket is static structure: bucket b
+    covers leaves ``boundaries[b]:boundaries[b+1]``, so its local
+    coordinate->leaf map is ``segment_ids(leaf_sizes[lo:hi]) + lo`` and
+    each bucket's stats are one sparse_selection_layer_stats call over
+    the GLOBAL leaf axis. Buckets partition the leaves, so summing the
+    per-bucket [L] arrays (each zero outside its own leaf range —
+    including tau, where segment_min over an empty segment reports 0)
+    recovers exactly the per-leaf stats the unbucketed path computes."""
+    L = len(leaf_sizes)
+    out: Dict[str, Array] = {}
+    for b, (a, v, i) in enumerate(zip(accs, vals_list, idx_list)):
+        lo, hi = int(boundaries[b]), int(boundaries[b + 1])
+        seg = segment_ids(leaf_sizes[lo:hi]) + np.int32(lo)
+        stats, _ = sparse_selection_layer_stats(a, v, i, seg, L)
+        out = (stats if not out
+               else {key: out[key] + stats[key] for key in out})
+    return out, mass_ratio(accs, vals_list)
 
 
 def dense_phase_selection_stats(

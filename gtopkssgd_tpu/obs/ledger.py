@@ -93,13 +93,21 @@ def _balanced_cap(k: int, p: int, n: int) -> int:
         return max(1, min(-(-3 * k // (2 * p)), k, -(-n // p)))
 
 
-def wire_mode_for(mode: str, schedule: Optional[str] = None) -> str:
-    """Comm-model key for (semantic mode, wire schedule): the layerwise
-    mode shares the flat tree's wire, and the 'balanced' schedule maps
-    the gtopk family onto the Ok-Topk model branch. None/'auto'/'tree'
-    keep the mode's historical model — exactly sparse_allreduce's plan
-    dispatch, so the ledger always prices the schedule that actually
-    ran."""
+def wire_mode_for(mode: str, schedule: Optional[str] = None,
+                  bucketing: Optional[str] = None) -> str:
+    """Comm-model key for (semantic mode, wire schedule, bucketing): the
+    layerwise mode shares the flat tree's wire, and the 'balanced'
+    schedule maps the gtopk family onto the Ok-Topk model branch.
+    None/'auto'/'tree' keep the mode's historical model — exactly
+    sparse_allreduce's plan dispatch, so the ledger always prices the
+    schedule that actually ran.
+
+    ``bucketing`` (parallel.bucketing.buckets_key grammar) changes the
+    merge MULTIPLICITY, not the per-merge model, so the key stays the
+    same base wire mode; pricing callers pass the bucket (n_b, k_b)
+    pairs to ``predict_comm_ms(buckets=...)`` and the model sums B
+    independent merges of that key. The parameter exists here so every
+    plan/ledger call site names the full wire decision in one place."""
     wm = "gtopk" if mode == "gtopk_layerwise" else mode
     if schedule == "balanced" and wm in ("gtopk", "gtopk_hier"):
         return "gtopk_balanced"
@@ -111,13 +119,27 @@ def predict_comm_ms(mode: str, p: int, *, n: int, k: int,
                     beta_gbps: float = DEFAULT_DCN_GBPS,
                     ici_gbps: float = DEFAULT_ICI_GBPS,
                     ici_size: int = 1,
-                    codec: str = "fp32") -> float:
+                    codec: str = "fp32",
+                    buckets: Optional[Sequence[Sequence[int]]] = None
+                    ) -> float:
     """Predicted comm_ms via scaling_model.predict when benchmarks/ is
     importable, else a pure alpha-beta tree model (rounds x alpha +
     bytes/beta on the slow link) — the degenerate ici_size=1 case of the
     full model, which is exactly the multi-process CPU/DCN topology the
     ledger's tests and typical --multihost runs live on. ``codec`` sets
-    the per-round sparse payload size (parallel.codec wire bytes)."""
+    the per-round sparse payload size (parallel.codec wire bytes).
+
+    ``buckets`` — ((n_b, k_b), ...) from a BucketPlan — prices the
+    bucketed layerwise wire: B independent merges, each over its
+    bucket-local index space, summed. The per-merge model is unchanged,
+    which is exactly what the bucketed optimizer path executes."""
+    if buckets:
+        return sum(
+            predict_comm_ms(mode, p, n=int(n_b), k=int(k_b),
+                            alpha_ms=alpha_ms, beta_gbps=beta_gbps,
+                            ici_gbps=ici_gbps, ici_size=ici_size,
+                            codec=codec)
+            for n_b, k_b in buckets)
     sm = _load_scaling_model()
     if sm is not None and hasattr(sm, "predict"):
         return sm.predict(mode, p, n=n, k=k, ici_gbps=ici_gbps,
@@ -199,9 +221,21 @@ def _manifest_params(manifest: Optional[Mapping[str, Any]]
     # (comm_plan_schedule; comm_plan is the plan NAME, kept for humans).
     # Pre-planner runs have neither -> None -> historical model.
     schedule = manifest.get("comm_plan_schedule")
+    # Bucketed layerwise runs additionally stamp the chosen partition
+    # (BucketPlan.to_manifest): per-bucket element counts and wire ks.
+    # Unbucketed runs (and every pre-bucketing run) have neither ->
+    # buckets=None -> the single-merge model.
+    sizes, ks = manifest.get("bucket_sizes"), manifest.get("bucket_ks")
+    buckets = None
+    if (isinstance(sizes, (list, tuple)) and isinstance(ks, (list, tuple))
+            and sizes and len(sizes) == len(ks)):
+        buckets = tuple(
+            (int(n_b), int(k_b)) for n_b, k_b in zip(sizes, ks))
     return {"mode": str(mode), "p": p, "n": n, "k": k,
             "codec": str(codec) if codec else "fp32",
-            "schedule": str(schedule) if schedule else None}
+            "schedule": str(schedule) if schedule else None,
+            "bucketing": str(manifest.get("buckets") or "concat"),
+            "buckets": buckets}
 
 
 def ledger_rows(records: Sequence[Mapping[str, Any]],
@@ -254,16 +288,20 @@ def ledger_rows(records: Sequence[Mapping[str, Any]],
         else:
             ici_size = 1
 
-    wm = wire_mode_for(params["mode"], params.get("schedule"))
+    wm = wire_mode_for(params["mode"], params.get("schedule"),
+                       bucketing=params.get("bucketing"))
+    buckets = params.get("buckets")
     predicted_ms = predict_comm_ms(
         wm, params["p"], n=params["n"], k=params["k"],
         alpha_ms=alpha_ms, beta_gbps=beta_gbps, ici_gbps=ici_gbps,
-        ici_size=ici_size, codec=params["codec"])
+        ici_size=ici_size, codec=params["codec"], buckets=buckets)
 
     base = {
         "mode": params["mode"], "p": params["p"],
         "n": params["n"], "k": params["k"], "codec": params["codec"],
         "schedule": params.get("schedule"),
+        "bucketing": params.get("bucketing", "concat"),
+        "n_buckets": len(buckets) if buckets else None,
         "alpha_ms": round(alpha_ms, 6), "beta_gbps": round(beta_gbps, 6),
         "ici_size": ici_size, "fit_source": fit_source,
         "predicted_comm_ms": round(predicted_ms, 6),
@@ -295,25 +333,35 @@ def ledger_rows(records: Sequence[Mapping[str, Any]],
             # per-device volume (codec set bytes per sparse round — 8k
             # under the fp32 identity; dense ring 2(p-1)/p x 4n). No
             # timing — the ratio checks volume accounting, the attr rows
-            # check time.
-            p, nn, k = params["p"], params["n"], params["k"]
-            set_bytes = _codec_set_bytes(params["codec"], k, nn)
+            # check time. Bucketed runs sum the per-merge volume over
+            # the stamped (n_b, k_b) pairs — the same B merges the
+            # optimizer issues and the telemetry counter models.
+            p = params["p"]
+
+            def _sparse_pred_bytes(k, nn):
+                set_bytes = _codec_set_bytes(params["codec"], k, nn)
+                if wm == "gtopk_balanced":
+                    # comm_bytes_per_step's balanced formula verbatim:
+                    # p-1 scatter rounds + a p-slice allgather, one
+                    # encoded cap-of-n set each.
+                    return max(1, 2 * p - 1) * _codec_set_bytes(
+                        params["codec"], _balanced_cap(k, p, nn), nn)
+                if wm in ("gtopk", "gtopk_hier"):
+                    return _tree_rounds_fallback(
+                        p if wm == "gtopk"
+                        else max(1, p // ici_size)) * set_bytes
+                if wm == "allgather":
+                    return set_bytes * (p - 1)
+                return 0.0
+
             if wm == "dense":
+                nn = params["n"]
                 pred_bytes = 2.0 * (p - 1) / p * 4 * nn if p > 1 else 0.0
-            elif wm == "gtopk_balanced":
-                # comm_bytes_per_step's balanced formula verbatim:
-                # p-1 scatter rounds + a p-slice allgather, one encoded
-                # cap-of-n set each.
-                pred_bytes = max(1, 2 * p - 1) * _codec_set_bytes(
-                    params["codec"], _balanced_cap(k, p, nn), nn)
-            elif wm in ("gtopk", "gtopk_hier"):
-                pred_bytes = _tree_rounds_fallback(
-                    p if wm == "gtopk"
-                    else max(1, p // ici_size)) * set_bytes
-            elif wm == "allgather":
-                pred_bytes = set_bytes * (p - 1)
+            elif buckets:
+                pred_bytes = sum(
+                    _sparse_pred_bytes(k_b, n_b) for n_b, k_b in buckets)
             else:
-                pred_bytes = 0.0
+                pred_bytes = _sparse_pred_bytes(params["k"], params["n"])
             rows.append({
                 **base, "source": "wire_bytes", "rank": rank,
                 "step": rec.get("step"),

@@ -857,9 +857,13 @@ def run_ledger(targets: Sequence[str], json_out: Optional[str] = None,
               "wire_bytes records)")
         return 1
     base = rows[0]
+    bucketing = base.get("bucketing", "concat")
+    bucket_note = ("" if bucketing in (None, "concat") else
+                   f" bucketing={bucketing} "
+                   f"n_buckets={base.get('n_buckets')}")
     print(f"ledger: mode={base['mode']} p={base['p']} n={base['n']} "
-          f"k={base['k']} codec={base.get('codec', 'fp32')}  "
-          f"alpha_ms={base['alpha_ms']} "
+          f"k={base['k']} codec={base.get('codec', 'fp32')}"
+          f"{bucket_note}  alpha_ms={base['alpha_ms']} "
           f"beta_gbps={base['beta_gbps']} ici_size={base['ici_size']} "
           f"(fit: {base['fit_source']})")
     print(f"predicted comm: {_fmt(base['predicted_comm_ms'])} ms/step")
@@ -947,7 +951,9 @@ def run_plan(run: str, json_out: Optional[str] = None) -> int:
         print(f"note: skipped {bad} malformed line(s)")
     decisions = [r for r in records if r.get("kind") == "plan"
                  and isinstance(r.get("candidates"), list)]
-    if not decisions:
+    bucket_recs = [r for r in records if r.get("kind") == "bucket"
+                   and isinstance(r.get("rows"), list)]
+    if not decisions and not bucket_recs:
         print("plan: no planner decision record (dense or single-device "
               "runs have no sparse wire to plan; pre-planner runs "
               "predate the record)")
@@ -975,10 +981,28 @@ def run_plan(run: str, json_out: Optional[str] = None) -> int:
                          _fmt(c.get('wire_bytes'))])
         print(_table(rows, ["candidate", "schedule", "comm_ms",
                             "wire_bytes/step"]))
+    # Bucket plan (parallel.bucketing): boundaries the run actually used
+    # plus the modeled ms of the degenerate partitions, so the reader
+    # sees where the chosen B sits on the alpha-beta curve.
+    for rec in bucket_recs:
+        print(f"buckets: {rec.get('buckets')} -> B={rec.get('n_buckets')}"
+              f" over L={rec.get('n_leaves')} leaves  "
+              f"(alpha_ms={rec.get('alpha_ms')} "
+              f"beta_gbps={rec.get('beta_gbps')})")
+        print(f"modeled comm ms: B=1 {_fmt(rec.get('modeled_ms_b1'))}  "
+              f"chosen {_fmt(rec.get('modeled_ms'))}  "
+              f"B=L {_fmt(rec.get('modeled_ms_leaf'))}")
+        rows = [[str(r.get("bucket")), str(r.get("leaves")),
+                 str(r.get("n_leaves")), str(r.get("elems")),
+                 str(r.get("k")), _fmt(r.get("wire_bytes")),
+                 _fmt(r.get("modeled_ms"))]
+                for r in rec["rows"]]
+        print(_table(rows, ["bucket", "leaves", "n_leaves", "elems", "k",
+                            "wire_bytes", "modeled_ms"]))
     if json_out:
         with open(json_out, "w") as fh:
-            json.dump({"decisions": decisions}, fh, indent=1,
-                      sort_keys=True)
+            json.dump({"decisions": decisions, "buckets": bucket_recs},
+                      fh, indent=1, sort_keys=True)
             fh.write("\n")
         print(f"wrote {json_out}")
     return 0

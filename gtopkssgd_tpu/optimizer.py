@@ -58,8 +58,9 @@ from gtopkssgd_tpu.ops import (
     topk_abs,
 )
 from gtopkssgd_tpu.parallel import (
-    get_codec, ici_dense_psum, resolve_plan, roundtrip_aligned,
-    sparse_allreduce, validate_pin)
+    get_codec, ici_dense_psum, parse_buckets, plan_buckets, resolve_plan,
+    roundtrip_aligned, sparse_allreduce, validate_pin)
+from gtopkssgd_tpu.parallel.bucketing import buckets_key
 
 Array = jax.Array
 ScalarOrSchedule = Union[float, Callable[[Array], Array]]
@@ -112,6 +113,7 @@ def gtopk_sgd(
     hier_ici_size: int = 1,
     wire_codec: str = "fp32",
     comm_plan: Optional[str] = "auto",
+    buckets: Union[str, int] = "concat",
     warmup_dense_steps: int = 0,
     momentum_correction: bool = False,
     telemetry: bool = False,
@@ -189,6 +191,28 @@ def gtopk_sgd(
     told were sent. Intermediate merge rounds requantize partial sums —
     that second-order error is shared bitwise-identically by all ranks
     (codec determinism) and is NOT residual-fed.
+
+    ``buckets`` (layerwise only; parallel.bucketing grammar ``concat |
+    leaf | <int B> | auto``) sets the MERGE GRANULARITY of the layerwise
+    path. The historical default ``concat`` keeps today's exact wire:
+    per-leaf selection, ONE merge over the concatenated set in the
+    global index space. Any other spec switches to the bucketed
+    pipeline: the leaves are partitioned into B contiguous byte-balanced
+    buckets (the alpha-beta DP of parallel.bucketing — ``auto`` also
+    chooses B, a pinned int or ``leaf`` fixes it), each bucket's (grad,
+    residual) leaves concatenate into one flat operand, selection runs
+    ONCE per bucket (k_b = ceil(density * n_b), the same fused two-stage
+    kernels as everywhere else), and each bucket runs its own
+    codec-framed merge in its BUCKET-LOCAL index space — B collectives
+    per step instead of one, each cheaper in latency-critical regimes
+    than L per-leaf merges and each with a smaller Elias-Fano index
+    space than the global merge. The reduced update and the
+    error-feedback residual scatter back to leaves through static bucket
+    offsets, so the state layout (per-leaf residual tuple) and
+    checkpoint treedef are identical to ``concat``. ``leaf`` (B = L) is
+    per-leaf selection AND per-leaf merges — the fully-layerwise end;
+    ``auto`` at B=1 is bit-identical to the flat ``gtopk`` pipeline over
+    the raveled model (same k: ceil(density * N)).
 
     ``momentum_correction`` (TPU extension, DGC arXiv:1712.01887 §3.1-3.2
     — not reference parity: the reference runs torch momentum-SGD on the
@@ -324,6 +348,17 @@ def gtopk_sgd(
     # WireCodec instance).
     comm_plan = validate_pin(comm_plan, mode, ici_size=hier_ici_size)
     codec_spec = getattr(codec, "name", "fp32")
+    # Same build-time discipline for --buckets: the spec parses (or
+    # fails) here; the partition itself is resolved at trace time, when
+    # the leaf sizes are known (plan_buckets below, memoized in the
+    # bucketing DP). Bucketing is a layerwise merge granularity — every
+    # other mode has exactly one wire set per step by construction.
+    bucket_spec = parse_buckets(buckets)
+    if bucket_spec != "concat" and not layerwise:
+        raise ValueError(
+            f"--buckets {buckets!r} only applies to the layerwise mode "
+            f"{LAYERWISE_MODES}; {mode!r} has a single wire set per step "
+            "already (use --buckets concat)")
     inner = optax.chain(
         optax.add_decayed_weights(weight_decay) if weight_decay else optax.identity(),
         # With momentum correction the velocity lives BEFORE the collective
@@ -430,10 +465,22 @@ def gtopk_sgd(
             scale = jnp.minimum(1.0, clip_grad_norm / (gnorm + 1e-6))
             flats = [f * scale for f in flats]
         p = bound_axis_size()
+        # Bucket partition for this (leaf_sizes, density, p, codec) —
+        # the alpha-beta DP of parallel.bucketing; None under the
+        # historical 'concat' wire. Resolved host-side at trace time
+        # (the DP table is memoized), so boundaries are static
+        # structure from here on, like offsets and ks.
+        bplan = (plan_buckets(tuple(sizes), density, buckets=bucket_spec,
+                              p=p, codec=codec_spec, mode=mode)
+                 if bucket_spec != "concat" else None)
+        wire_k_total = bplan.k_total if bplan is not None else kk_total
         # Wire plan for this (mode, mesh, n, k, codec) — chosen by the
         # topology planner unless pinned; None at p=1 (no wire).
-        plan = (resolve_plan(mode, p, n, kk_total, codec_spec, 1,
-                             comm_plan)
+        # Bucketed runs key and score the candidates on the (n_b, k_b)
+        # pairs — B merges each, not one concatenated merge.
+        plan = (resolve_plan(mode, p, n, wire_k_total, codec_spec, 1,
+                             comm_plan, None, buckets_key(bucket_spec),
+                             bplan.pairs() if bplan is not None else None)
                 if p > 1 else None)
 
         if correction:
@@ -593,6 +640,175 @@ def gtopk_sgd(
                 tel = (tel,)
             return (dense_fl, tuple(repaired), u_out) + tel
 
+        def bucketed_sparse_branch(srcs, res_in, us):
+            """Per-BUCKET select/feedback/merge (parallel.bucketing).
+
+            Same pipeline as sparse_branch run B times over bucket
+            concatenations instead of once over leaves + one global
+            merge: each bucket's (grad, residual) leaves concatenate
+            into one flat operand, selection runs once per bucket with
+            k_b = ceil(density * n_b), and the merge runs in the
+            BUCKET-LOCAL index space (n = n_b) — B collectives per step,
+            each a strictly smaller instance of the same codec-framed
+            exchange. State stays per leaf: the residual, update, and
+            (under correction) velocity scatter back through the static
+            bucket offsets, so checkpoints and the warm-up dense branch
+            see the identical per-leaf structure. At B=1 this IS the
+            flat gtopk pipeline over the raveled model; at B=L it is
+            per-leaf selection with per-leaf merges."""
+            B = bplan.n_buckets
+            ranges = [bplan.leaf_range(b) for b in range(B)]
+            bks = list(bplan.ks)
+            bns = list(bplan.sizes)
+
+            def bconcat(parts):
+                return [parts[lo] if hi - lo == 1
+                        else jnp.concatenate(parts[lo:hi])
+                        for lo, hi in ranges]
+
+            def bsplit(bufs):
+                """Per-bucket flats -> per-leaf flats (static slices)."""
+                out = []
+                for (lo, hi), buf in zip(ranges, bufs):
+                    off = 0
+                    for s in sizes[lo:hi]:
+                        out.append(buf[off:off + s])
+                        off += s
+                return out
+
+            bsrcs = bconcat(srcs)
+            bres = bconcat(res_in)
+            bus = bconcat(us) if correction else []
+            accs = [s + r for s, r in zip(bsrcs, bres)]
+
+            def _bucket_audit(hits_fn_per_bucket):
+                """Exact-vs-production recall against the bucketed
+                ground truth: per-bucket exact top-k_b (the contract the
+                bucketed selection implements), hits concatenated into
+                one recall fraction. Only exists inside the cond's
+                taken branch."""
+                def _do():
+                    hits, evs = [], []
+                    for b, (a, kb) in enumerate(zip(accs, bks)):
+                        ev, ei = topk_abs(a, kb)
+                        hits.append(hits_fn_per_bucket(b, ei))
+                        evs.append(ev)
+                    return obs_counters.topk_recall(
+                        jnp.concatenate(hits), jnp.concatenate(evs))
+
+                return lax.cond(
+                    (state.count % telemetry_audit_interval) == 0,
+                    _do, lambda: jnp.float32(-1.0))
+
+            tel = ()
+            if p == 1:
+                # Threshold form per bucket (see sparse_branch's p=1
+                # note): compressor.k(n_b) == k_b by construction, so
+                # the shared helper applies bucket by bucket.
+                sel = [compressor.compress_by_threshold(
+                           a, grad=s, residual=r)
+                       for a, s, r in zip(accs, bsrcs, bres)]
+                keeps = [keep for keep, _, _ in sel]
+                new_res = [r for _, r, _ in sel]
+                u_out_b = ([jnp.where(m, 0.0, u)
+                            for u, m in zip(bus, keeps)]
+                           if correction else [])
+                dense_b = [a - r for a, r in zip(accs, new_res)]
+                if telemetry:
+                    taus = jnp.stack([t for _, _, t in sel])
+                    kept = taus > 0
+                    tel = {
+                        "tau": jnp.where(
+                            jnp.any(kept),
+                            jnp.min(jnp.where(kept, taus, jnp.inf)), 0.0),
+                        "sent": sum(jnp.sum(m.astype(jnp.float32))
+                                    for m in keeps),
+                        "m_k": obs_counters.mass_ratio(accs, dense_b),
+                    }
+                    if telemetry_layers:
+                        # Per-leaf stats from per-leaf slices of the
+                        # bucket accumulator/selection — same values the
+                        # unbucketed path reduces, just sliced out of
+                        # the concatenations.
+                        tel["lsel"], _ = (
+                            obs_counters.leafwise_selection_stats(
+                                bsplit(accs), bsplit(dense_b)))
+                    if audit:
+                        tel["recall"] = _bucket_audit(
+                            lambda b, ei: jnp.take(
+                                keeps[b], ei, mode="clip"))
+                    tel = (tel,)
+                dense_fl = bsplit(dense_b)
+                res_fl = tuple(bsplit(new_res))
+                u_out = tuple(bsplit(u_out_b)) if correction else us
+                return (dense_fl, res_fl, u_out) + tel
+            sel = [select_topk(s, kb, topk_method, residual=r)
+                   for s, r, kb in zip(bsrcs, bres, bks)]
+            idx_b = [i for _, i in sel]
+            vals_b = [v for v, _ in sel]
+            new_res = [a.at[i].set(0.0, mode="drop")
+                       for a, i in zip(accs, idx_b)]
+            # Momentum factor masking at the LOCAL (bucket) selection —
+            # same measured-ablation rationale as the other paths.
+            u_out_b = ([u.at[i].set(0.0, mode="drop")
+                        for u, i in zip(bus, idx_b)]
+                       if correction else [])
+            if codec.lossy:
+                # Wire-error fold per bucket: requantize in the
+                # bucket-local index space (the smaller n_b is exactly
+                # what shrinks the codec's index words) and fold the
+                # error into the bucket residual before the merge.
+                vq_b = [roundtrip_aligned(codec, v, i, n=nb)
+                        for v, i, nb in zip(vals_b, idx_b, bns)]
+                new_res = [r.at[i].add(v - vq, mode="drop")
+                           for r, i, v, vq in
+                           zip(new_res, idx_b, vals_b, vq_b)]
+                vals_b = vq_b
+            repaired, dense_bufs, rejected_b = [], [], []
+            for v, i, r, kb, nb in zip(vals_b, idx_b, new_res, bks, bns):
+                gvals, gidx, _ = sparse_allreduce(
+                    mode, v, i, k=kb, n=nb,
+                    axis_name=axis_name, axis_size=p, codec=codec,
+                    plan=plan,
+                )
+                rejected = ~membership_mask(i, gidx)
+                rejected_b.append(rejected)
+                repaired.append(
+                    r.at[i].add(jnp.where(rejected, v, 0.0),
+                                mode="drop"))
+                dense_bufs.append(scatter_add_dense(nb, gidx, gvals) / p)
+            if correction and _restore_rejected_u:
+                # Ablation arm only — see the sparse_branch note.
+                u_out_b = [
+                    u_masked.at[i].add(
+                        jnp.where(rej, u_orig[i], 0.0), mode="drop")
+                    for u_masked, u_orig, i, rej in
+                    zip(u_out_b, bus, idx_b, rejected_b)]
+            dense_fl = bsplit(dense_bufs)
+            if telemetry:
+                tel = {
+                    "tau": obs_counters.selected_tau(
+                        jnp.concatenate(vals_b)),
+                    "sent": sum(obs_counters.sent_count(v)
+                                for v in vals_b),
+                    "m_k": obs_counters.mass_ratio(accs, vals_b),
+                }
+                if telemetry_layers:
+                    tel["lsel"], _ = (
+                        obs_counters.bucketed_sparse_selection_stats(
+                            accs, vals_b, idx_b, sizes,
+                            bplan.boundaries))
+                if audit:
+                    tel["recall"] = _bucket_audit(
+                        lambda b, ei: membership_mask(ei, idx_b[b]))
+                tel = (tel,)
+            res_fl = tuple(bsplit(repaired))
+            u_out = tuple(bsplit(u_out_b)) if correction else us
+            return (dense_fl, res_fl, u_out) + tel
+
+        if bplan is not None:
+            sparse_branch = bucketed_sparse_branch
+
         if warmup_dense_steps > 0:
             def dense_branch(srcs, res_in, us):
                 if p > 1:
@@ -633,8 +849,9 @@ def gtopk_sgd(
         updates, inner_state = inner.update(avg_grads, state.inner, params)
         if telemetry:
             tel = obs_counters.make_telemetry(
-                n=n, k=kk_total, p=p, mode=mode, codec=codec,
+                n=n, k=wire_k_total, p=p, mode=mode, codec=codec,
                 schedule=plan.schedule if plan is not None else None,
+                buckets=bplan.pairs() if bplan is not None else None,
                 grad_norm_pre=obs_counters.tree_l2(flats),
                 grad_norm_post=obs_counters.tree_l2(dense_fl),
                 residual_norm=obs_counters.tree_l2(res_struct),

@@ -8,6 +8,13 @@ exchanges ride ICI for the gTop-k tree, `all_gather` implements the DGC
 baseline, `psum` the dense baseline. No threads, no host staging, no D2H/H2D.
 """
 
+from gtopkssgd_tpu.parallel.bucketing import (
+    BUCKETS_DEFAULT,
+    BucketPlan,
+    buckets_key,
+    parse_buckets,
+    plan_buckets,
+)
 from gtopkssgd_tpu.parallel.codec import (
     CODEC_NAMES,
     WireCodec,
@@ -37,6 +44,11 @@ from gtopkssgd_tpu.parallel.planner import (
 )
 
 __all__ = [
+    "BUCKETS_DEFAULT",
+    "BucketPlan",
+    "buckets_key",
+    "parse_buckets",
+    "plan_buckets",
     "CODEC_NAMES",
     "WireCodec",
     "get_codec",

@@ -67,8 +67,12 @@ class CommPlan:
     ``schedule`` is the slow-axis algorithm (modes.SCHEDULES), ``intra``
     the ICI-axis phase ('psum' for the hier mode's in-slice dense
     allreduce, 'none' otherwise), ``codec`` the sparse payload codec
-    spec, ``ici_size`` the ICI-domain width the plan assumes. The name
-    is the plan grammar the ``--comm-plan`` flag speaks.
+    spec, ``ici_size`` the ICI-domain width the plan assumes,
+    ``bucketing`` the layerwise merge granularity
+    (parallel.bucketing.buckets_key grammar: 'concat' = the historical
+    single concatenated merge, 'leaf' = one merge per leaf, 'b{B}' /
+    'auto' = the DP partition). The name is the plan grammar the
+    ``--comm-plan`` flag speaks.
     """
 
     name: str
@@ -77,13 +81,15 @@ class CommPlan:
     intra: str = "none"
     codec: str = "fp32"
     ici_size: int = 1
+    bucketing: str = "concat"
 
     @property
     def wire_mode(self) -> str:
         """Comm-model key (scaling_model.predict / ledger) this plan
         prices as — the single mapping shared with the ledger."""
         from gtopkssgd_tpu.obs.ledger import wire_mode_for
-        return wire_mode_for(self.mode, self.schedule)
+        return wire_mode_for(self.mode, self.schedule,
+                             bucketing=self.bucketing)
 
 
 def _norm_mode(mode: Optional[str]) -> str:
@@ -91,10 +97,13 @@ def _norm_mode(mode: Optional[str]) -> str:
 
 
 def candidate_plans(mode: Optional[str], *, codec: str = "fp32",
-                    ici_size: int = 1) -> Tuple[CommPlan, ...]:
+                    ici_size: int = 1,
+                    bucketing: str = "concat") -> Tuple[CommPlan, ...]:
     """Every wire plan that realizes ``mode``'s semantics, historical
     default FIRST (selection uses a stable min, so the default wins all
-    ties and all model-indifferent regimes)."""
+    ties and all model-indifferent regimes). ``bucketing`` is carried on
+    the gtopk-family candidates only — it is a layerwise merge
+    granularity, orthogonal to which schedule each merge runs."""
     m = _norm_mode(mode)
     if m in DENSE_MODES:
         return (CommPlan("dense", m, "psum", "none", codec, 1),)
@@ -107,8 +116,9 @@ def candidate_plans(mode: Optional[str], *, codec: str = "fp32",
         return (CommPlan("hier", m, "tree", "psum", codec,
                          max(1, ici_size)),)
     if m in GTOPK_MODES or m in LAYERWISE_MODES:
-        return (CommPlan("tree", m, "tree", "none", codec, 1),
-                CommPlan("balanced", m, "balanced", "none", codec, 1))
+        return (CommPlan("tree", m, "tree", "none", codec, 1, bucketing),
+                CommPlan("balanced", m, "balanced", "none", codec, 1,
+                         bucketing))
     raise ValueError(f"unknown mode {mode!r}")
 
 
@@ -147,17 +157,20 @@ def planner_inputs(probe_dir: Optional[str] = None) -> Dict[str, Any]:
 
 
 def score_plan(plan: CommPlan, p: int, *, n: int, k: int,
-               alpha_ms: float, beta_gbps: float,
-               ici_gbps: float) -> float:
+               alpha_ms: float, beta_gbps: float, ici_gbps: float,
+               buckets: Optional[Tuple[Tuple[int, int], ...]] = None
+               ) -> float:
     """Predicted comm_ms of one candidate — scaling_model.predict when
     benchmarks/ is present, the ledger's pure alpha-beta model
     otherwise. The same number the ledger later audits against measured
-    T_comm, so a plan decision is always reconcilable post-hoc."""
+    T_comm, so a plan decision is always reconcilable post-hoc.
+    ``buckets`` (the BucketPlan's ((n_b, k_b), ...) pairs) prices the
+    bucketed wire as B independent merges."""
     from gtopkssgd_tpu.obs.ledger import predict_comm_ms
     return predict_comm_ms(
         plan.wire_mode, p, n=n, k=k, alpha_ms=alpha_ms,
         beta_gbps=beta_gbps, ici_gbps=ici_gbps,
-        ici_size=plan.ici_size, codec=plan.codec)
+        ici_size=plan.ici_size, codec=plan.codec, buckets=buckets)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -179,6 +192,7 @@ class PlanDecision:
             "wire_mode": self.plan.wire_mode,
             "mode": self.plan.mode,
             "intra": self.plan.intra,
+            "bucketing": self.plan.bucketing,
             "pin": self.pin,
             # numeric so the gate smoke can pin "defaults kept the
             # historical wire" as a baseline check
@@ -194,12 +208,18 @@ def build_decision(mode: Optional[str], *, p: int, n: int, k: int,
                    probe_dir: Optional[str] = None,
                    alpha_ms: Optional[float] = None,
                    beta_gbps: Optional[float] = None,
-                   ici_gbps: Optional[float] = None) -> PlanDecision:
+                   ici_gbps: Optional[float] = None,
+                   bucketing: str = "concat",
+                   buckets: Optional[Tuple[Tuple[int, int], ...]] = None
+                   ) -> PlanDecision:
     """Score every candidate plan for (mode, mesh, n, k, codec) and pick
     one: the pinned plan when ``pin`` names one, else the cheapest under
     the model (stable min — the historical default wins ties). Explicit
     alpha/beta/ici arguments override the probe-artifact lookup (tests,
-    what-if scoring)."""
+    what-if scoring). ``bucketing``/``buckets`` (the resolved --buckets
+    key and the BucketPlan's (n_b, k_b) pairs) make the candidate scores
+    price the bucketed wire — B merges, each over its bucket-local index
+    space — instead of the single concatenated merge."""
     pin = validate_pin(pin, mode, ici_size=ici_size)
     inputs = planner_inputs(probe_dir)
     if alpha_ms is not None:
@@ -208,18 +228,27 @@ def build_decision(mode: Optional[str], *, p: int, n: int, k: int,
         inputs["beta_gbps"], inputs["fit_source"] = float(beta_gbps), "arg"
     if ici_gbps is not None:
         inputs["ici_gbps"] = float(ici_gbps)
-    cands = candidate_plans(mode, codec=codec, ici_size=ici_size)
+    cands = candidate_plans(mode, codec=codec, ici_size=ici_size,
+                            bucketing=bucketing)
     scored: List[Dict[str, Any]] = []
     for cand in cands:
         ms = score_plan(cand, p, n=n, k=k, alpha_ms=inputs["alpha_ms"],
                         beta_gbps=inputs["beta_gbps"],
-                        ici_gbps=inputs["ici_gbps"])
+                        ici_gbps=inputs["ici_gbps"], buckets=buckets)
+        wire_bytes = (
+            sum(comm_bytes_per_step(cand.mode, n_b, k_b, p,
+                                    ici_size=cand.ici_size,
+                                    codec=cand.codec,
+                                    schedule=cand.schedule)
+                for n_b, k_b in buckets)
+            if buckets else
+            comm_bytes_per_step(cand.mode, n, k, p,
+                                ici_size=cand.ici_size, codec=cand.codec,
+                                schedule=cand.schedule))
         scored.append({
             "name": cand.name, "schedule": cand.schedule,
             "wire_mode": cand.wire_mode, "comm_ms": round(ms, 6),
-            "wire_bytes": comm_bytes_per_step(
-                cand.mode, n, k, p, ici_size=cand.ici_size,
-                codec=cand.codec, schedule=cand.schedule),
+            "wire_bytes": wire_bytes,
         })
     if pin != "auto":
         chosen = next(c for c in cands if c.name == pin)
@@ -236,10 +265,17 @@ def build_decision(mode: Optional[str], *, p: int, n: int, k: int,
 def resolve_plan(mode: Optional[str], p: int, n: int, k: int,
                  codec: str = "fp32", ici_size: int = 1,
                  pin: Optional[str] = "auto",
-                 probe_dir: Optional[str] = None) -> CommPlan:
+                 probe_dir: Optional[str] = None,
+                 bucketing: str = "concat",
+                 buckets: Optional[Tuple[Tuple[int, int], ...]] = None
+                 ) -> CommPlan:
     """The optimizer's trace-time entry point: (mode, mesh, n, k, codec,
     pin) -> CommPlan, memoized — the decision is made once per distinct
-    shape, never per step, and retracing costs a dict lookup."""
+    shape, never per step, and retracing costs a dict lookup. The
+    bucketing key and (n_b, k_b) pairs are part of the memo key, so a
+    bucketed and an unbucketed run of the same shape resolve
+    independently."""
     return build_decision(mode, p=p, n=n, k=k, codec=codec,
                           ici_size=ici_size, pin=pin,
-                          probe_dir=probe_dir).plan
+                          probe_dir=probe_dir, bucketing=bucketing,
+                          buckets=buckets).plan

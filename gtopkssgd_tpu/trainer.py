@@ -93,6 +93,13 @@ class TrainConfig:
                                    # alpha-beta model; a plan name (tree |
                                    # balanced | allgather | hier | dense)
                                    # pins the schedule for this mode
+    buckets: str = "concat"        # gtopk_layerwise only: gradient
+                                   # bucketing (parallel.bucketing grammar:
+                                   # concat | leaf | auto | an int B).
+                                   # 'concat' = historical single-merge
+                                   # wire; 'leaf' = one merge per param
+                                   # leaf; 'auto'/B = alpha-beta-optimal
+                                   # byte-balanced contiguous buckets
     clip_grad_norm: Optional[float] = None  # default: LSTMs clip (ref §3.4)
     nsteps_update: int = 1
     warmup_epochs: int = 0         # linear LR ramp over the first N epochs
@@ -451,19 +458,40 @@ class Trainer:
         # schedule that actually ran. Dense / single-device runs have no
         # sparse wire to plan.
         self._plan_decision = None
+        # Bucket plan (parallel.bucketing): resolved host-side from the
+        # SAME leaf sizes the optimizer's trace-time plan_buckets sees
+        # (params pytree flatten order), so the manifest/"bucket" record
+        # describe the boundaries that actually ran. Layerwise-only.
+        self._bucket_plan = None
+        if cfg.compression == "gtopk_layerwise":
+            from gtopkssgd_tpu.parallel import parse_buckets, plan_buckets
+            if parse_buckets(cfg.buckets) != "concat":
+                leaf_sizes = tuple(
+                    int(leaf.size)
+                    for leaf in jax.tree_util.tree_leaves(self.state.params))
+                self._bucket_plan = plan_buckets(
+                    leaf_sizes, cfg.density, buckets=cfg.buckets,
+                    p=self.p, codec=cfg.wire_codec)
         if cfg.compression not in (None, "none", "dense") and self.p > 1:
             from gtopkssgd_tpu.parallel import build_decision
-            k = max(1, int(np.ceil(cfg.density * self.num_params)))
+            from gtopkssgd_tpu.parallel.bucketing import buckets_key
+            bplan = self._bucket_plan
+            k = (bplan.k_total if bplan is not None
+                 else max(1, int(np.ceil(cfg.density * self.num_params))))
             self._plan_decision = build_decision(
                 cfg.compression, p=self.p, n=self.num_params, k=k,
                 codec=cfg.wire_codec, ici_size=cfg.hier_ici,
-                pin=cfg.comm_plan)
+                pin=cfg.comm_plan,
+                bucketing=buckets_key(cfg.buckets),
+                buckets=bplan.pairs() if bplan is not None else None)
         plan_extra = {}
         if self._plan_decision is not None:
             d = self._plan_decision
             plan_extra = {"comm_plan": d.plan.name,
                           "comm_plan_schedule": d.plan.schedule,
                           "comm_plan_pin": d.pin}
+        if self._bucket_plan is not None:
+            plan_extra.update(self._bucket_plan.to_manifest())
         # Run-manifest header: first record of every metrics file, so
         # each is self-describing (config hash + resolved headline flags,
         # mesh, jax/backend versions, git sha). In sharded multi-process
@@ -475,6 +503,9 @@ class Trainer:
         if self._plan_decision is not None:
             self.metrics.log("plan", flush=True,
                              **self._plan_decision.record())
+        if self._bucket_plan is not None:
+            self.metrics.log("bucket", flush=True,
+                             **self._bucket_record())
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
         # Degrade fallback (recover-policy "degrade"): the sparse step
@@ -507,6 +538,40 @@ class Trainer:
         )
         self._set_iters(start_epoch=0)
 
+    def _bucket_record(self) -> dict:
+        """The "bucket" evidence record: the chosen BucketPlan's
+        boundaries and per-bucket rows, plus the modeled comm ms of the
+        two degenerate partitions (B=1 single merge, B=L per-leaf) so a
+        report reader can see where the chosen B sits on the alpha-beta
+        curve without re-running the DP."""
+        from gtopkssgd_tpu.parallel import bucketing, plan_buckets
+        from gtopkssgd_tpu.parallel.planner import planner_inputs
+        cfg, bplan = self.cfg, self._bucket_plan
+        inputs = planner_inputs(None)
+        alpha, beta = inputs["alpha_ms"], inputs["beta_gbps"]
+        kw = dict(p=self.p, codec=cfg.wire_codec,
+                  alpha_ms=alpha, beta_gbps=beta)
+        sizes = bplan.leaf_sizes
+
+        def _ms(spec):
+            alt = plan_buckets(sizes, cfg.density, buckets=spec, **kw)
+            return bucketing.partition_cost_ms(alt, **kw)
+
+        return {
+            "buckets": bplan.spec,
+            "n_buckets": bplan.n_buckets,
+            "n_leaves": len(sizes),
+            "boundaries": list(bplan.boundaries),
+            "bucket_sizes": list(bplan.sizes),
+            "bucket_ks": list(bplan.ks),
+            "rows": bucketing.describe(bplan, **kw),
+            "modeled_ms": bucketing.partition_cost_ms(bplan, **kw),
+            "modeled_ms_b1": _ms(1),
+            "modeled_ms_leaf": _ms("leaf"),
+            "alpha_ms": alpha,
+            "beta_gbps": beta,
+        }
+
     def _make_tx(self, warmup_dense_steps: Optional[int] = None):
         """The optimizer transform; ``warmup_dense_steps`` overrides the
         config-derived value (the degrade fallback passes 2**30 to pin
@@ -526,6 +591,7 @@ class Trainer:
             topk_method=cfg.topk_method,
             wire_codec=cfg.wire_codec,
             comm_plan=cfg.comm_plan,
+            buckets=cfg.buckets,
             clip_grad_norm=cfg.clip_grad_norm,
             axis_name="dp" if self.p > 1 else None,
             hier_ici_size=cfg.hier_ici,

@@ -63,6 +63,11 @@ KINDS = frozenset({
     "plan",        # comm-planner decision (parallel/planner.py): chosen
                    # wire plan + every candidate's modeled score; also
                    # the gate smoke's balanced-vs-tree A/B evidence row
+    "bucket",      # gradient-bucketing evidence row (parallel/bucketing):
+                   # trainer logs the chosen BucketPlan (boundaries,
+                   # per-bucket k, modeled ms for B in {1, chosen, L});
+                   # the gate smoke logs the bucketed-vs-leafwise A/B
+                   # (collective-count ratio, audited recall, bytes ratio)
 })
 
 _SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")

@@ -1,0 +1,318 @@
+"""Byte-balanced gradient bucketing (`--buckets`): unit invariants.
+
+The bucketed layerwise path (parallel/bucketing.py + the optimizer's
+bucketed branch) partitions the param leaves into B contiguous buckets,
+concatenates each bucket's (grad, residual) leaves, and runs ONE fused
+selection + ONE codec-framed merge per bucket. These tests pin the
+degenerate ends exactly and the DP against brute force:
+
+  * grammar: parse_buckets accepts concat|leaf|auto|int and rejects junk;
+  * DP optimality: optimal_boundaries matches exhaustive search over all
+    contiguous partitions (pinned B and auto), on random leaf lists;
+  * manifest round-trip: BucketPlan -> to_manifest -> JSON ->
+    from_manifest preserves the (n_b, k_b) pricing structure;
+  * B=L at p=1 bit-equals the historical concat layerwise (selection is
+    per-leaf in both; no merge exists at p=1);
+  * B=1 bit-equals flat gtopk at p in {2,3,5} — updates AND residuals —
+    including under the lossy int8 codec (error-feedback scatter-back
+    exactness);
+  * B=L at p>1 bit-equals one independent flat gtopk pipeline per leaf;
+  * pinned B=2 bit-equals two independent flat pipelines over the
+    bucket-concatenated arrays (the scatter-back is exactly a reshape);
+  * collective_count telemetry: leaf counts L merges, auto at the
+    committed ~22 ms alpha collapses to B=1.
+"""
+
+import itertools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from gtopkssgd_tpu.optimizer import gtopk_sgd
+from gtopkssgd_tpu.parallel import BucketPlan, make_mesh, parse_buckets
+from gtopkssgd_tpu.parallel import bucketing
+
+
+def tree_params():
+    return {
+        "conv": jnp.zeros((4, 8)),   # 32 elems
+        "bias": jnp.zeros((5,)),     # 5 elems
+        "bn": jnp.zeros((2, 3)),     # 6 elems
+        "head": jnp.zeros((3, 4)),   # 12 elems
+    }
+
+
+def rand_grads(rng, params, lead=()):
+    return jax.tree.map(
+        lambda p: jnp.asarray(
+            rng.standard_normal(lead + p.shape), jnp.float32), params)
+
+
+# ------------------------------------------------------------------ grammar
+
+def test_parse_buckets_grammar():
+    assert parse_buckets("concat") == "concat"
+    assert parse_buckets("leaf") == "leaf"
+    assert parse_buckets("auto") == "auto"
+    assert parse_buckets("4") == 4
+    assert parse_buckets(3) == 3
+    for bad in ("0", "-1", "tree", "", 0, -2, 1.5, True, None):
+        with pytest.raises((ValueError, TypeError)):
+            parse_buckets(bad)
+
+
+def test_non_layerwise_mode_rejects_buckets():
+    with pytest.raises(ValueError):
+        gtopk_sgd(0.1, compression="gtopk", density=0.1, buckets="auto")
+    # concat is the no-op default and composes with every mode
+    gtopk_sgd(0.1, compression="gtopk", density=0.1, buckets="concat")
+
+
+# ----------------------------------------------------------- DP vs brute
+
+def _brute_force(sizes, density, n_buckets, **kw):
+    """Cheapest contiguous partition by exhaustive enumeration."""
+    L = len(sizes)
+    best = (np.inf, None)
+    rng = (range(n_buckets - 1, n_buckets) if n_buckets is not None
+           else range(0, L))
+    for b_minus_1 in rng:
+        for cuts in itertools.combinations(range(1, L), b_minus_1):
+            bounds = (0,) + cuts + (L,)
+            plan = BucketPlan(
+                bounds, tuple(sizes),
+                tuple(bucketing.k_for_density(sum(sizes[lo:hi]), density)
+                      for lo, hi in zip(bounds, bounds[1:])),
+                spec="auto")
+            cost = bucketing.partition_cost_ms(plan, **kw)
+            if cost < best[0] - 1e-12:
+                best = (cost, bounds)
+    return best
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("n_buckets", [None, 2, 3])
+def test_dp_matches_brute_force(seed, n_buckets):
+    rng = np.random.default_rng(seed)
+    L = int(rng.integers(3, 8))
+    sizes = tuple(int(s) for s in rng.integers(4, 400, size=L))
+    density = 0.05
+    kw = dict(p=int(rng.integers(2, 6)), codec="int8:16", schedule=None,
+              alpha_ms=float(rng.uniform(0.01, 5.0)), beta_gbps=0.6,
+              mode="gtopk_layerwise")
+    bounds = bucketing.optimal_boundaries(
+        sizes, density, n_buckets=n_buckets, **kw)
+    plan = BucketPlan(
+        bounds, sizes,
+        tuple(bucketing.k_for_density(sum(sizes[lo:hi]), density)
+              for lo, hi in zip(bounds, bounds[1:])), spec="auto")
+    got = bucketing.partition_cost_ms(plan, **kw)
+    want, _ = _brute_force(sizes, density, n_buckets, **kw)
+    assert got == pytest.approx(want, rel=1e-9)
+    if n_buckets is not None:
+        assert len(bounds) == min(n_buckets, L) + 1
+
+
+# ------------------------------------------------------ manifest roundtrip
+
+def test_manifest_roundtrip():
+    sizes = (32, 5, 6, 12)
+    plan = bucketing.plan_buckets(sizes, 0.125, buckets=2, p=4,
+                                  alpha_ms=1.0, beta_gbps=0.6)
+    man = json.loads(json.dumps(plan.to_manifest()))
+    back = BucketPlan.from_manifest(man)
+    assert back is not None
+    assert back.pairs() == plan.pairs()
+    assert back.n_buckets == plan.n_buckets
+    assert back.k_total == plan.k_total
+    # non-bucketed manifests reconstruct to None
+    assert BucketPlan.from_manifest({"buckets": "concat"}) is None
+    assert BucketPlan.from_manifest({}) is None
+
+
+# -------------------------------------------------------- degenerate ends
+
+def _p1_run(buckets, steps=3, codec="fp32"):
+    params = tree_params()
+    tx = gtopk_sgd(0.5, momentum=0.9, compression="gtopk_layerwise",
+                   density=0.125, buckets=buckets, wire_codec=codec,
+                   axis_name=None)
+    state = jax.jit(tx.init)(params)
+    upd = jax.jit(tx.update)
+    rng = np.random.default_rng(7)
+    outs = []
+    for _ in range(steps):
+        grads = rand_grads(rng, params)
+        updates, state = upd(grads, state, params)
+        outs.append(updates)
+    return outs, state
+
+
+def test_leaf_p1_bit_equals_concat():
+    # At p=1 both paths select per leaf and never merge, so B=L must be
+    # BIT-identical to the historical concat layerwise — updates and
+    # error-feedback residuals alike.
+    u_leaf, s_leaf = _p1_run("leaf")
+    u_cat, s_cat = _p1_run("concat")
+    for a, b in zip(u_leaf, u_cat):
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    for ra, rb in zip(s_leaf.residual, s_cat.residual):
+        np.testing.assert_array_equal(np.asarray(ra), np.asarray(rb))
+
+
+def _spmd_run(tx, params, mesh, p, steps, seed):
+    def step(params, state, grads):
+        grads = jax.tree.map(lambda g: g[0], grads)
+        updates, state = tx.update(grads, state, params)
+        return optax.apply_updates(params, updates), updates, state
+
+    smapped = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P("dp")),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    ))
+    state = jax.jit(tx.init)(params)
+    rng = np.random.default_rng(seed)
+    ups = []
+    for _ in range(steps):
+        grads = rand_grads(rng, params, lead=(p,))
+        params, updates, state = smapped(params, state, grads)
+        ups.append(updates)
+    return ups, state
+
+
+@pytest.mark.parametrize("p", [2, 3, 5])
+@pytest.mark.parametrize("codec", ["fp32", "int8:16"])
+def test_b1_bit_equals_flat_gtopk(p, codec):
+    # B=1 concatenates every leaf into one buffer and runs the flat
+    # pipeline verbatim: select_topk over grad+residual, identical
+    # residual/update masking, same codec fold, one sparse_allreduce.
+    # ravel order == concat of per-leaf ravels, so updates AND residuals
+    # are bit-identical to compression='gtopk' — including the int8
+    # codec's error scatter-back into the residual.
+    params = tree_params()
+    mesh = make_mesh(p)
+    kw = dict(momentum=0.9, density=0.125, wire_codec=codec,
+              axis_name="dp", axis_size=p)
+    tx_b = gtopk_sgd(0.5, compression="gtopk_layerwise", buckets=1, **kw)
+    tx_f = gtopk_sgd(0.5, compression="gtopk", **kw)
+    u_b, s_b = _spmd_run(tx_b, params, mesh, p, 3, seed=11)
+    u_f, s_f = _spmd_run(tx_f, params, mesh, p, 3, seed=11)
+    for a, b in zip(u_b, u_f):
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    # bucketed residual is per-leaf; flat's is one [N] buffer in the
+    # same tree-flatten order
+    res_b = np.concatenate([np.asarray(r).ravel() for r in s_b.residual])
+    res_f = np.concatenate([np.asarray(r).ravel() for r in
+                            jax.tree.leaves(s_f.residual)])
+    np.testing.assert_array_equal(res_b, res_f)
+
+
+@pytest.mark.parametrize("p", [2, 3])
+def test_leaf_bit_equals_per_leaf_flat_pipelines(p):
+    # B=L runs the flat pipeline once per leaf over that leaf's own index
+    # space — so it must bit-equal L INDEPENDENT flat-gtopk optimizers,
+    # one per single-leaf pytree.
+    params = tree_params()
+    mesh = make_mesh(p)
+    kw = dict(momentum=0.9, density=0.125, axis_name="dp", axis_size=p)
+    tx_b = gtopk_sgd(0.5, compression="gtopk_layerwise", buckets="leaf",
+                     **kw)
+    u_b, _ = _spmd_run(tx_b, params, mesh, p, 2, seed=13)
+    for name in params:
+        sub = {name: params[name]}
+        tx_f = gtopk_sgd(0.5, compression="gtopk", **kw)
+        # same grads: regenerate the full-tree stream and slice the leaf
+        rng = np.random.default_rng(13)
+        state = jax.jit(tx_f.init)(sub)
+        sub_p = sub
+        smapped = None
+        for step_i in range(2):
+            grads = rand_grads(rng, params, lead=(p,))
+            sub_g = {name: grads[name]}
+            if smapped is None:
+                def stepf(params, state, grads):
+                    grads = jax.tree.map(lambda g: g[0], grads)
+                    updates, state = tx_f.update(grads, state, params)
+                    return (optax.apply_updates(params, updates),
+                            updates, state)
+                smapped = jax.jit(jax.shard_map(
+                    stepf, mesh=mesh,
+                    in_specs=(P(), P(), P("dp")),
+                    out_specs=(P(), P(), P()),
+                    check_vma=False,
+                ))
+            sub_p, upd, state = smapped(sub_p, state, sub_g)
+            np.testing.assert_array_equal(
+                np.asarray(upd[name]), np.asarray(u_b[step_i][name]))
+
+
+def test_pinned_b2_bit_equals_two_flat_pipelines():
+    # A pinned B=2 concatenates each bucket's leaves; running the flat
+    # pipeline over each bucket's own concatenated array must reproduce
+    # it exactly (the leaf scatter-back is a pure reshape).
+    p = 2
+    params = tree_params()
+    mesh = make_mesh(p)
+    kw = dict(momentum=0.9, density=0.125, axis_name="dp", axis_size=p)
+    tx_b = gtopk_sgd(0.5, compression="gtopk_layerwise", buckets=2, **kw)
+    u_b, _ = _spmd_run(tx_b, params, mesh, p, 2, seed=17)
+
+    names = sorted(params)  # jax flattens dicts in sorted-key order
+    sizes = tuple(int(params[n].size) for n in names)
+    plan = bucketing.plan_buckets(sizes, 0.125, buckets=2, p=p)
+    assert plan.n_buckets == 2
+
+    rng = np.random.default_rng(17)
+    grads_stream = [rand_grads(rng, params, lead=(p,)) for _ in range(2)]
+    for b in range(2):
+        lo, hi = plan.leaf_range(b)
+        bnames = names[lo:hi]
+        sub = {"x": jnp.concatenate(
+            [params[n].reshape(-1) for n in bnames])}
+        tx_f = gtopk_sgd(0.5, compression="gtopk", **kw)
+        state = jax.jit(tx_f.init)(sub)
+
+        def stepf(params, state, grads):
+            grads = jax.tree.map(lambda g: g[0], grads)
+            updates, state = tx_f.update(grads, state, params)
+            return optax.apply_updates(params, updates), updates, state
+
+        smapped = jax.jit(jax.shard_map(
+            stepf, mesh=mesh,
+            in_specs=(P(), P(), P("dp")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        ))
+        sub_p = sub
+        for step_i, grads in enumerate(grads_stream):
+            sub_g = {"x": jnp.concatenate(
+                [grads[n].reshape(p, -1) for n in bnames], axis=1)}
+            sub_p, upd, state = smapped(sub_p, state, sub_g)
+            want = np.concatenate(
+                [np.asarray(u_b[step_i][n]).reshape(-1)
+                 for n in bnames])
+            np.testing.assert_array_equal(np.asarray(upd["x"]), want)
+
+
+# ---------------------------------------------------------- telemetry
+
+def test_collective_count_telemetry():
+    p = 2
+    params = tree_params()
+    mesh = make_mesh(p)
+    L = len(jax.tree.leaves(params))
+    for buckets, want in (("leaf", L), ("auto", 1), (3, 3), ("concat", 1)):
+        tx = gtopk_sgd(0.5, compression="gtopk_layerwise", density=0.125,
+                       buckets=buckets, axis_name="dp", axis_size=p,
+                       telemetry=True)
+        _, state = _spmd_run(tx, params, mesh, p, 1, seed=19)
+        assert float(state.telemetry["collective_count"]) == want, buckets
