@@ -421,6 +421,116 @@ def run_bucket_smoke(out_dir: str) -> dict:
     return rec
 
 
+def run_calib_smoke(out_dir: str) -> dict:
+    """Self-calibrating comm-model smoke (the ISSUE-13 tentpole's
+    consumer): drives obs/calib.py and obs/registry.py against SYNTHETIC
+    ground truth — no trainer, no timing noise, so the baseline can pin
+    the estimator itself tight. A 32-sample stream generated from the
+    exact alpha-beta decomposition (alpha=4 ms, beta=2 Gbps, p=4 gtopk
+    tree) with every 10th sample inflated 5x (an injected straggler)
+    feeds a CommCalibrator whose reference is the committed ~22 ms
+    4-proc probe fit. Returns the fields the main run logs as ONE
+    "calib" record:
+
+      alpha_fit_ms / beta_fit_gbps  robust fit over the full stream;
+                                 the stragglers must not drag it off
+                                 the known constants (tight rtol)
+      n_refits / drift_events    structural: 32 samples / window of 8
+                                 -> exactly 4 refits; comm_drift_warmup
+                                 =2 of them armed -> exactly 2 firings
+                                 of comm_model_drift vs the stale probe
+      fit_src_is_calib           the end-of-run artifact round-trips
+                                 through planner_inputs: next run's
+                                 planner would price with THIS run's
+                                 measured fit, not the probe — the
+                                 obs->planner loop, closed
+
+    Alongside, the registry contract is exercised offline (synthetic
+    record streams through report's history/regress CLI paths) and the
+    exit codes are pinned as a "regress" record: 2 on an empty
+    registry, 0 against itself, 1 on a 10x-worsened loss, 0 from
+    history — the same contract ``report gate`` follows."""
+    import json as _json
+
+    from gtopkssgd_tpu.obs import report
+    from gtopkssgd_tpu.obs import registry as _registry
+    from gtopkssgd_tpu.obs.calib import CommCalibrator, message_count
+    from gtopkssgd_tpu.obs.events import AnomalyMonitor
+    from gtopkssgd_tpu.parallel.planner import planner_inputs
+
+    true_alpha, true_beta = 4.0, 2.0
+    p, wire_mode = 4, "gtopk"
+    msgs = message_count(wire_mode, p)
+    mon = AnomalyMonitor(halt_on=None)
+    cal = CommCalibrator(
+        wire_mode, p,
+        baseline={"alpha_ms": 21.8594, "beta_gbps": 0.6,
+                  "fit_source": "dcn_probe_4proc.json"},
+        monitor=mon, refit_interval=8, min_samples=4)
+    n_refits = 0
+    for i in range(32):
+        b = 200_000 + 40_000 * (i % 8)
+        t = msgs * (true_alpha + (b / msgs) * 8e-6 / true_beta)
+        if i % 10 == 0:
+            t *= 5.0  # injected straggler: the fit must ride through
+        if cal.observe(i, b, t) is not None:
+            n_refits += 1
+    fit = cal.final_fit()
+    calib_dir = os.path.join(out_dir, "calib_probe")
+    art = cal.write_artifact(calib_dir, manifest={"config_hash": "smoke"})
+    inputs = planner_inputs(calib_dir)
+    src_ok = (art is not None
+              and inputs.get("fit_source") == os.path.basename(art))
+
+    # Registry exit-code contract on synthetic runs (subdirs, so
+    # resolve_paths on the parent never sees their metrics.jsonl).
+    def _write_run(name: str, loss: float) -> str:
+        d = os.path.join(out_dir, name)
+        os.makedirs(d, exist_ok=True)
+        recs = [
+            {"kind": "manifest", "time": 100.0, "rank": 0,
+             "config_hash": "calib_smoke", "git_sha": "0" * 7},
+            {"kind": "train", "time": 101.0, "rank": 0, "step": 1,
+             "loss": loss},
+            {"kind": "train", "time": 103.0, "rank": 0, "step": 5,
+             "loss": loss},
+            {"kind": "calib", "time": 103.5, "rank": 0, "step": 5,
+             "alpha_fit_ms": fit["alpha_ms"],
+             "beta_fit_gbps": fit["beta_gbps"],
+             "n_samples": fit["n_samples"]},
+        ]
+        with open(os.path.join(d, "metrics.jsonl"), "w") as fh:
+            for r in recs:
+                fh.write(_json.dumps(r) + "\n")
+        return d
+
+    reg_dir = os.path.join(out_dir, "calib_registry")
+    run_a = _write_run("calib_run_a", loss=1.5)
+    rc_empty = report.run_regress(run_a, reg_dir)
+    recs_a, _ = report.load_records(run_a)
+    _registry.append_run(reg_dir, _registry.run_summary(recs_a))
+    rc_pass = report.run_regress(run_a, reg_dir)
+    rc_fail = report.run_regress(_write_run("calib_run_b", loss=15.0),
+                                 reg_dir)
+    rc_history = report.run_history(reg_dir)
+    return {
+        "alpha_fit_ms": fit["alpha_ms"],
+        "beta_fit_gbps": fit["beta_gbps"],
+        "alpha_true_ms": true_alpha,
+        "beta_true_gbps": true_beta,
+        "resid_ms": fit["resid_ms"],
+        "n_samples": float(fit["n_samples"]),
+        "n_refits": float(n_refits),
+        "drift_events": float(mon.summary().get("comm_model_drift", 0)),
+        "fit_src_is_calib": 1.0 if src_ok else 0.0,
+        "planner_alpha_ms": inputs["alpha_ms"],
+        "regress_rc_empty": float(rc_empty),
+        "regress_rc_pass": float(rc_pass),
+        "regress_rc_fail": float(rc_fail),
+        "history_rc": float(rc_history),
+    }
+
+
 def run_smoke(out_dir: str) -> str:
     """Train the canonical run; returns the run dir (metrics.jsonl inside).
 
@@ -455,6 +565,7 @@ def run_smoke(out_dir: str) -> str:
     codec_rec = run_codec_smoke(out_dir)
     plan_rec = run_plan_smoke(out_dir, codec_rec)
     bucket_rec = run_bucket_smoke(out_dir)
+    calib_rec = run_calib_smoke(out_dir)
 
     cfg = smoke_config(out_dir)
     with Trainer(cfg) as t:
@@ -506,6 +617,17 @@ def run_smoke(out_dir: str) -> str:
         # floor on the bucketed arm, and the bucket-summed ledger's
         # modeled-vs-measured bytes ratio.
         t.metrics.log("bucket", **bucket_rec)
+        # And the calibration smoke: the robust fit pinned against its
+        # synthetic ground truth, the exact refit/drift-firing counts,
+        # the closed obs->planner artifact round-trip, and (as a
+        # separate "regress" record) the registry CLI's exit-code
+        # contract. Both kinds are durable -> flush=True.
+        _regress_keys = ("regress_rc_empty", "regress_rc_pass",
+                         "regress_rc_fail", "history_rc")
+        t.metrics.log("calib", flush=True, **{
+            k: v for k, v in calib_rec.items() if k not in _regress_keys})
+        t.metrics.log("regress", flush=True, **{
+            k: v for k, v in calib_rec.items() if k in _regress_keys})
         # Static-analysis gate: run graftlint in-process over the
         # package + benchmarks against the committed repo baseline and
         # record the counts; the gate pins non_baselined at exactly 0,

@@ -134,6 +134,37 @@ reference's only telemetry was text logs):
                                          OpenMetrics text on localhost
                                          (curl localhost:PORT/metrics);
                                          0 = off (default), -1 = ephemeral
+    --obs-calib / --no-obs-calib         live comm-model calibration
+                                         (obs.calib): profile-attribute a
+                                         dispatch every
+                                         --obs-calib-interval steps, fit
+                                         alpha/beta online from measured
+                                         (wire_bytes, t_comm) with a
+                                         robust (median-of-slopes)
+                                         estimator; 'calib' records per
+                                         refit, comm_model_drift anomaly
+                                         vs the planner's inputs, and an
+                                         end-of-run calib_fit_{P}proc
+                                         .json artifact the next run's
+                                         planner consumes (default off —
+                                         each sample costs a capture)
+    --obs-calib-interval N               steps between calibration
+                                         captures (default 25)
+    --registry DIR                       append one summary line per run
+                                         to DIR/runs.jsonl (obs.registry:
+                                         manifest header + steps/sec,
+                                         comm ratio, fitted alpha/beta,
+                                         recall floor, wire bytes/step);
+                                         read back with 'report history' /
+                                         'report regress'
+    --comm-model-fit PATH                explicit alpha/beta artifact
+                                         (dcn_probe_*.json or
+                                         calib_fit_*.json) pricing the
+                                         comm planner, with the filename
+                                         stamped as fit provenance in the
+                                         manifest and the decided
+                                         schedule pinned into the
+                                         optimizer
 
 Resilience flags (gtopkssgd_tpu/resilience — turn detect-and-halt into
 detect-and-recover):
@@ -340,6 +371,35 @@ def build_argparser() -> argparse.ArgumentParser:
                         "(obs.exporter; curl localhost:PORT/metrics); "
                         "0 disables (default), -1 binds an ephemeral "
                         "port (logged at startup)")
+    p.add_argument("--obs-calib", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="live comm-model calibration (obs.calib): every "
+                        "--obs-calib-interval steps, profile-attribute "
+                        "one dispatch and feed measured (wire_bytes, "
+                        "t_comm) to an online robust alpha/beta fitter — "
+                        "'calib' records per refit, a comm_model_drift "
+                        "anomaly when the live fit diverges from the "
+                        "planner's inputs, and an end-of-run "
+                        "calib_fit_{P}proc.json artifact in out-dir that "
+                        "the next run's planner can consume. Opt-in: "
+                        "each sample costs a profiler capture + sync")
+    p.add_argument("--obs-calib-interval", type=int, default=25,
+                   help="optimizer steps between calibration captures")
+    p.add_argument("--registry", default=None, metavar="DIR",
+                   help="append this run's summary line (manifest subset "
+                        "+ steps/sec, comm ratio, fitted alpha/beta, "
+                        "recall floor, wire bytes/step) to DIR/runs.jsonl "
+                        "on exit (obs.registry); inspect offline with "
+                        "'report history DIR' and gate with 'report "
+                        "regress OUT_DIR --registry DIR'")
+    p.add_argument("--comm-model-fit", default=None, metavar="PATH",
+                   help="explicit alpha/beta fit artifact (a dcn_probe_*"
+                        ".json or calib_fit_*.json) pricing the comm "
+                        "planner instead of the probe-dir lookup; the "
+                        "filename lands in the manifest/plan record as "
+                        "fit provenance and the decided schedule is "
+                        "pinned through to the optimizer. A malformed "
+                        "file fails at startup")
     p.add_argument("--inject", default=None, metavar="SPEC",
                    help="step-keyed fault injection (resilience subsystem; "
                         "grammar KIND[:ARG...]@STEP|A-B|latest, comma-"
@@ -421,6 +481,10 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         obs_halt_on=args.obs_halt_on,
         obs_timeline=args.obs_timeline,
         obs_export_port=args.obs_export_port,
+        obs_calib=args.obs_calib,
+        obs_calib_interval=args.obs_calib_interval,
+        registry=args.registry,
+        comm_model_fit=args.comm_model_fit,
         inject=args.inject,
         recover_policy=args.recover_policy,
         allow_ckpt_mismatch=args.allow_ckpt_mismatch,

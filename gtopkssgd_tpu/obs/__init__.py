@@ -63,6 +63,19 @@ residual dynamics). This package turns the repo's scattered primitives
       stdlib http.server thread serving the latest value of every
       metric field at localhost:PORT/metrics; wired in as the
       MetricsLogger sink.
+  calib.py    — online comm-model calibrator (``--obs-calib``): fits
+      {alpha_ms, beta_gbps} live from the run's own measured
+      (wire_bytes, t_comm) samples with an outlier-robust Theil-Sen
+      estimator, logs "calib" records per refit window, feeds the
+      comm_model_drift anomaly rule, and writes a dcn_probe-compatible
+      calib_fit_{P}proc.json artifact at end of run that the planner
+      consumes next run — the obs->planner loop, closed.
+  registry.py — append-only cross-run registry (``--registry DIR``):
+      one runs.jsonl line per run (manifest subset + steps/sec, comm
+      ratio, fitted alpha/beta, recall floor, wire bytes/step); read
+      back offline via ``report history`` (trend table keyed by
+      config_hash) and ``report regress`` (current run vs registry
+      baseline under per-field rtol checks, gate exit contract).
 
 Per-layer counters (counters.LAYER_FIELDS, flag-gated): achieved
 density, tau, pre/post-compression norms, error-feedback residual norm
@@ -73,6 +86,12 @@ exact-vs-production top-k recall audit reusing ops.topk's exact path as
 ground truth.
 """
 
+from gtopkssgd_tpu.obs.calib import (
+    CommCalibrator,
+    fit_alpha_beta,
+    load_fit_file,
+    message_count,
+)
 from gtopkssgd_tpu.obs.counters import (
     LAYER_FIELDS,
     TELEMETRY_FIELDS,
@@ -114,6 +133,7 @@ __all__ = [
     "TELEMETRY_FIELDS",
     "AnomalyHalt",
     "AnomalyMonitor",
+    "CommCalibrator",
     "MetricsExporter",
     "Thresholds",
     "TimelineRecorder",
@@ -121,11 +141,14 @@ __all__ = [
     "StallWatchdog",
     "config_hash",
     "coordinator_address",
+    "fit_alpha_beta",
     "git_sha",
     "keep_tau",
     "layer_names",
+    "load_fit_file",
     "make_telemetry",
     "mass_ratio",
+    "message_count",
     "run_manifest",
     "selected_tau",
     "sent_count",

@@ -31,6 +31,16 @@ synced (no extra device reads):
                                   merger (obs/fleet.py) through
                                   ``observe_ranks``, so --obs-halt-on
                                   covers it like any other rule
+  comm_model_drift      warn      the live calibrator's alpha/beta fit
+                                  (obs/calib.py) diverges from the
+                                  planner's committed inputs by more
+                                  than ``comm_drift_x`` in either
+                                  direction, after ``comm_drift_warmup``
+                                  prior refits — the comm model that
+                                  priced the schedule/bucketing no
+                                  longer describes the fabric. Fed by
+                                  CommCalibrator.refit through
+                                  ``observe_comm_model``
 
 Each firing emits one severity-tagged ``event`` record through
 MetricsLogger with ``flush=True`` (fsync'd — a run killed one line later
@@ -81,6 +91,9 @@ class Thresholds:
     straggler_lag_x: float = 2.0     # auto threshold: x * step duration
     straggler_ewma_alpha: float = 0.3    # EWMA decay for per-rank lag
     straggler_warmup: int = 2        # merged steps before the rule arms
+    comm_drift_x: float = 4.0        # live fit vs planner inputs, either
+                                     # direction (max of a/b and b/a)
+    comm_drift_warmup: int = 2       # refits before the drift rule arms
 
     def age_max(self, rho: Optional[float]) -> float:
         if self.residual_age_max > 0:
@@ -144,6 +157,9 @@ class AnomalyMonitor:
         # from the fleet merger; public — fleet straggler rows report it.
         self.rank_lag_ewma: Dict[int, float] = {}
         self._rank_lag_n: Dict[int, int] = {}
+        # Refits seen so far, fed by the comm calibrator — the drift
+        # rule arms only after comm_drift_warmup prior refits.
+        self._comm_fit_n = 0
 
     # ---------------------------------------------------------- the rules
     def _check(self, step: int, loss: Optional[float],
@@ -244,6 +260,47 @@ class AnomalyMonitor:
             self._rank_lag_n[rank] = n + 1
         return out
 
+    # --------------------------------------------- comm model drift (calib)
+    def _check_comm_model(self, step: int, alpha_ms: Optional[float],
+                          beta_gbps: Optional[float],
+                          ref_alpha_ms: Optional[float],
+                          ref_beta_gbps: Optional[float],
+                          fit_source: Optional[str]
+                          ) -> List[Dict[str, Any]]:
+        th = self.th
+        worst = None  # (factor, name, fit, ref)
+        for name, fit, ref in (("alpha_ms", alpha_ms, ref_alpha_ms),
+                               ("beta_gbps", beta_gbps, ref_beta_gbps)):
+            if not _finite(fit) or not _finite(ref):
+                continue
+            # Floor both sides so a fit collapsing to ~0 reads as a huge
+            # finite factor instead of a ZeroDivisionError.
+            a, b = max(float(fit), 1e-6), max(float(ref), 1e-6)
+            factor = max(a / b, b / a)
+            if worst is None or factor > worst[0]:
+                worst = (factor, name, fit, ref)
+        out: List[Dict[str, Any]] = []
+        # Arm-before-update, like the straggler rule: the first
+        # comm_drift_warmup refits (the fit is still converging on few
+        # samples) can never fire.
+        if (worst is not None and self._comm_fit_n >= th.comm_drift_warmup
+                and worst[0] > th.comm_drift_x):
+            factor, name, fit, ref = worst
+            src = f" (planner fit: {fit_source})" if fit_source else ""
+            out.append({
+                "rule": "comm_model_drift", "severity": "warn",
+                "step": step, "value": round(factor, 6),
+                "threshold": round(th.comm_drift_x, 6),
+                "param": name,
+                "message": (f"live {name} fit {float(fit):.4g} is "
+                            f"{factor:.3g}x off the planner's committed "
+                            f"{float(ref):.4g}{src} — the comm model "
+                            "that priced this run's schedule is stale"),
+            })
+        if worst is not None:
+            self._comm_fit_n += 1
+        return out
+
     # ------------------------------------------------------------- public
     def _emit(self, fired: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         """Record, persist (fsync'd), mark on the timeline, and — after
@@ -290,6 +347,20 @@ class AnomalyMonitor:
         merger). Same emit/halt contract as observe — a persistent
         straggler trips --obs-halt-on warn exactly like a loss spike."""
         return self._emit(self._check_ranks(step, dict(lags), step_dur))
+
+    def observe_comm_model(self, step: int, alpha_ms: Optional[float],
+                           beta_gbps: Optional[float], *,
+                           ref_alpha_ms: Optional[float] = None,
+                           ref_beta_gbps: Optional[float] = None,
+                           fit_source: Optional[str] = None
+                           ) -> List[Dict[str, Any]]:
+        """Evaluate the comm_model_drift rule against one refit of the
+        live calibrator (obs/calib.py) vs the planner's committed
+        reference fit. Same emit/halt contract as observe — a drifted
+        comm model trips --obs-halt-on warn like any other anomaly."""
+        return self._emit(self._check_comm_model(
+            step, alpha_ms, beta_gbps, ref_alpha_ms, ref_beta_gbps,
+            fit_source))
 
     def summary(self) -> Dict[str, int]:
         """{rule: count} over the monitor's lifetime (test/report aid)."""

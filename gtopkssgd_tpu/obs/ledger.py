@@ -36,6 +36,7 @@ import importlib.util
 import json
 import math
 import os
+import re
 from typing import Any, Dict, List, Mapping, Optional, Sequence
 
 # scaling_model.py main() defaults — mirrored here for the fallback path
@@ -169,23 +170,51 @@ def predict_comm_ms(mode: str, p: int, *, n: int, k: int,
     raise ValueError(mode)
 
 
+# Fit-artifact filename grammar: the probe writes dcn_probe_{P}proc.json,
+# the in-run calibrator (obs/calib.py) writes calib_fit_{P}proc.json with
+# the same alpha_beta_fit payload. One regex recovers (family, P) for the
+# numeric precedence sort below.
+_FIT_ARTIFACT_RE = re.compile(r"^(dcn_probe|calib_fit)_(\d+)proc\.json$")
+
+
+def _fit_artifact_key(path: str):
+    """Precedence sort key (higher wins): proc count NUMERICALLY first —
+    the docstring's "largest proc count present" contract, which a plain
+    lexicographic basename sort breaks the moment two counts share no
+    digit width (it ranked 8proc over 16proc) — then, at equal P, a
+    calib_fit over a dcn_probe: the calibrator measured THIS workload's
+    wire in-situ, the probe measured synthetic pings."""
+    m = _FIT_ARTIFACT_RE.match(os.path.basename(path))
+    if m is None:
+        return (-1, 0, os.path.basename(path))
+    return (int(m.group(2)), 1 if m.group(1) == "calib_fit" else 0,
+            os.path.basename(path))
+
+
 def load_alpha_beta(search_dir: Optional[str] = None,
                     nprocs: Optional[int] = None
                     ) -> Optional[Dict[str, float]]:
-    """The fitted {alpha_ms, beta_gbps} from a dcn_probe artifact
-    (``dcn_probe_{n}proc.json``), or None. ``nprocs`` picks the exact
-    artifact; otherwise the largest proc count present wins (closest to
-    a real fleet). Default search dir: benchmarks/results/."""
+    """The fitted {alpha_ms, beta_gbps} from a fit artifact —
+    ``dcn_probe_{n}proc.json`` (benchmarks/dcn_probe.py) or
+    ``calib_fit_{n}proc.json`` (obs/calib.py, the in-run calibrator) —
+    or None. ``nprocs`` restricts to that exact proc count; otherwise
+    the largest proc count present wins (closest to a real fleet), with
+    proc counts compared numerically. At equal proc count a calib_fit
+    outranks a dcn_probe (the calibrator measured the actual workload's
+    collectives; the probe measured synthetic point-to-point pings).
+    Default search dir: benchmarks/results/."""
     if search_dir is None:
         repo = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         search_dir = os.path.join(repo, "benchmarks", "results")
     if nprocs is not None:
-        paths = [os.path.join(search_dir, f"dcn_probe_{nprocs}proc.json")]
+        paths = [os.path.join(search_dir, f"calib_fit_{nprocs}proc.json"),
+                 os.path.join(search_dir, f"dcn_probe_{nprocs}proc.json")]
     else:
         paths = sorted(
-            glob.glob(os.path.join(search_dir, "dcn_probe_*proc.json")),
-            key=lambda pth: os.path.basename(pth), reverse=True)
+            glob.glob(os.path.join(search_dir, "dcn_probe_*proc.json"))
+            + glob.glob(os.path.join(search_dir, "calib_fit_*proc.json")),
+            key=_fit_artifact_key, reverse=True)
     for path in paths:
         try:
             with open(path) as fh:

@@ -1,0 +1,275 @@
+"""Cross-run regression registry: append-only per-workspace run memory.
+
+A single run's record stream answers "what happened in THIS run"; nothing
+in the repo remembers the run before it, so a 20% throughput regression
+or a comm model drifting across a redeploy is invisible until someone
+diffs two metrics.jsonl files by hand. This module gives a workspace that
+memory: every run appends ONE line (manifest header + end-of-run summary
+stats) to ``runs.jsonl`` in a registry directory, and the report CLI
+reads it back offline:
+
+    python -m gtopkssgd_tpu.obs.report history REGISTRY_DIR
+    python -m gtopkssgd_tpu.obs.report regress RUN --registry REGISTRY_DIR
+
+``history`` prints the trend table (keyed by config_hash — only runs of
+the same configuration are comparable); ``regress`` summarizes the
+current run from its shards, picks the most recent registry entry with
+the same config_hash as baseline, and applies rtol-per-field drift
+checks with the ``report gate`` exit contract: 0 within tolerance, 1
+regression, 2 usage/no-baseline. Entries are plain JSON lines — the
+registry needs no daemon, survives partial writes (bad lines are
+skipped and counted), and merges across machines with ``cat``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+REGISTRY_NAME = "runs.jsonl"
+
+# Manifest keys copied into each entry: config_hash keys comparability,
+# the rest make a registry line readable without the run directory.
+_MANIFEST_KEYS = ("config_hash", "git_sha", "dnn", "dataset",
+                  "compression", "density", "wire_codec", "nworkers",
+                  "batch_size", "seed")
+
+# Regression checks: (field, rtol, atol). Gate tolerance semantics —
+# FAIL when |current - baseline| > atol + rtol*|baseline|. Throughput
+# and loss are noisy (25%); comm ratio noisier still; fitted alpha/beta
+# tolerate a full 2x before flagging (factor-level drift is what the
+# live comm_model_drift rule exists for — the registry catches the
+# slow cross-run creep); wire bytes/step is deterministic (10% covers
+# codec padding jitter only); recall floor gets an absolute slack so a
+# floor of 0.0 doesn't make the check vacuous.
+REGRESS_CHECKS: Tuple[Tuple[str, float, float], ...] = (
+    ("steps_per_sec", 0.25, 0.0),
+    ("loss_last", 0.25, 0.0),
+    ("mean_comm_ratio", 0.50, 0.0),
+    ("alpha_ms", 1.00, 0.0),
+    ("beta_gbps", 1.00, 0.0),
+    ("recall_floor", 0.25, 0.05),
+    ("wire_bytes_per_step", 0.10, 0.0),
+)
+
+
+def _finite(x: Any) -> bool:
+    return isinstance(x, (int, float)) and math.isfinite(x)
+
+
+def registry_path(registry_dir: str) -> str:
+    return os.path.join(registry_dir, REGISTRY_NAME)
+
+
+def _cell(v: Any) -> str:
+    """Table cell: report._fmt for numbers, "-" for absent stats."""
+    if _finite(v):
+        from gtopkssgd_tpu.obs.report import _fmt
+        return _fmt(float(v))
+    return "-" if v is None else str(v)
+
+
+def run_summary(records: Sequence[Dict[str, Any]]
+                ) -> Optional[Dict[str, Any]]:
+    """Distill one run's record stream into a registry entry: manifest
+    subset + summary stats. Stats a run didn't produce (no calib
+    records, no audits) are simply absent — ``regress`` treats a field
+    missing on both sides as not-applicable, present-then-vanished as a
+    failure. Returns None when the stream has no manifest (nothing to
+    key comparisons on)."""
+    manifest = None
+    trains: List[Dict[str, Any]] = []
+    last_calib = None
+    final_status = None
+    recall_floor = None
+    wire_sum, wire_n = 0.0, 0
+    ratio_sum, ratio_n = 0.0, 0
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "manifest" and manifest is None:
+            manifest = rec
+        elif kind == "train":
+            trains.append(rec)
+        elif kind == "calib":
+            last_calib = rec
+        elif kind == "obs":
+            recall = rec.get("audit_recall")
+            if _finite(recall) and recall >= 0:
+                recall_floor = (recall if recall_floor is None
+                                else min(recall_floor, recall))
+            wb = rec.get("wire_bytes")
+            if _finite(wb) and wb > 0:
+                wire_sum += float(wb)
+                wire_n += 1
+        elif kind == "attr":
+            # measured comm share of the dispatch — the ledger's
+            # numerator; ratio vs total is schedule-independent
+            tc, tt = rec.get("t_comm_us"), rec.get("t_total_us")
+            if _finite(tc) and _finite(tt) and tt > 0:
+                ratio_sum += float(tc) / float(tt)
+                ratio_n += 1
+        elif kind == "recovery" and rec.get("final_status") is not None:
+            final_status = rec.get("final_status")
+    if manifest is None:
+        return None
+    entry: Dict[str, Any] = {"time": manifest.get("time")}
+    for key in _MANIFEST_KEYS:
+        if manifest.get(key) is not None:
+            entry[key] = manifest[key]
+    stats: Dict[str, Any] = {}
+    steps = [r for r in trains
+             if _finite(r.get("step")) and _finite(r.get("time"))]
+    if len(steps) >= 2:
+        dt = steps[-1]["time"] - steps[0]["time"]
+        ds = steps[-1]["step"] - steps[0]["step"]
+        if dt > 0 and ds > 0:
+            stats["steps_per_sec"] = round(ds / dt, 6)
+    if trains:
+        stats["n_steps"] = trains[-1].get("step")
+        loss = trains[-1].get("loss")
+        if _finite(loss):
+            stats["loss_last"] = round(float(loss), 6)
+    if ratio_n:
+        stats["mean_comm_ratio"] = round(ratio_sum / ratio_n, 6)
+    if last_calib is not None:
+        if _finite(last_calib.get("alpha_fit_ms")):
+            stats["alpha_ms"] = last_calib["alpha_fit_ms"]
+        if _finite(last_calib.get("beta_fit_gbps")):
+            stats["beta_gbps"] = last_calib["beta_fit_gbps"]
+    if recall_floor is not None:
+        stats["recall_floor"] = round(float(recall_floor), 6)
+    if wire_n:
+        stats["wire_bytes_per_step"] = round(wire_sum / wire_n, 2)
+    if final_status is not None:
+        stats["final_status"] = final_status
+    entry["stats"] = stats
+    return entry
+
+
+def append_run(registry_dir: str, entry: Dict[str, Any]) -> str:
+    """Append one entry (fsync'd — a registry line is the run's only
+    cross-run trace, it must survive the process dying right after)."""
+    os.makedirs(registry_dir, exist_ok=True)
+    path = registry_path(registry_dir)
+    with open(path, "a") as fh:
+        fh.write(json.dumps(entry, sort_keys=True) + "\n")
+        fh.flush()
+        try:
+            os.fsync(fh.fileno())
+        except OSError:
+            pass
+    return path
+
+
+def load_registry(registry_dir: str) -> Tuple[List[Dict[str, Any]], int]:
+    """All parseable entries in file order, plus the count of bad lines
+    (a torn write from a killed run must not poison the registry)."""
+    path = registry_path(registry_dir)
+    entries: List[Dict[str, Any]] = []
+    bad = 0
+    if not os.path.exists(path):
+        return entries, bad
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if isinstance(rec, dict):
+                entries.append(rec)
+            else:
+                bad += 1
+    return entries, bad
+
+
+def history_rows(entries: Sequence[Dict[str, Any]],
+                 config_hash: Optional[str] = None
+                 ) -> List[List[str]]:
+    """Trend-table rows (newest last) for ``report history``; filtered
+    to one config_hash when given."""
+    rows = []
+    for e in entries:
+        if config_hash and e.get("config_hash") != config_hash:
+            continue
+        stats = e.get("stats") or {}
+        rows.append([
+            str(e.get("config_hash", "?"))[:16],
+            str(e.get("git_sha", "?"))[:10],
+            _cell(stats.get("n_steps")),
+            _cell(stats.get("steps_per_sec")),
+            _cell(stats.get("loss_last")),
+            _cell(stats.get("mean_comm_ratio")),
+            _cell(stats.get("alpha_ms")),
+            _cell(stats.get("beta_gbps")),
+            _cell(stats.get("recall_floor")),
+            _cell(stats.get("wire_bytes_per_step")),
+            str(stats.get("final_status", "-")),
+        ])
+    return rows
+
+
+HISTORY_HEADER = ["config", "git", "steps", "steps/s", "loss",
+                  "comm_ratio", "alpha_ms", "beta_gbps", "recall",
+                  "wireB/step", "status"]
+
+
+def pick_baseline(entry: Dict[str, Any],
+                  entries: Sequence[Dict[str, Any]],
+                  allow_mismatch: bool = False
+                  ) -> Optional[Dict[str, Any]]:
+    """Most recent registry entry with the current run's config_hash
+    (comparing runs of different configurations is apples-to-oranges —
+    opt in explicitly with allow_mismatch)."""
+    want = entry.get("config_hash")
+    matches = [e for e in entries
+               if want is not None and e.get("config_hash") == want]
+    if matches:
+        return matches[-1]
+    if allow_mismatch and entries:
+        return entries[-1]
+    return None
+
+
+def regress(entry: Dict[str, Any], baseline: Dict[str, Any]
+            ) -> Tuple[List[List[str]], int]:
+    """Field-by-field drift check of ``entry`` against ``baseline``
+    under REGRESS_CHECKS. Returns (table rows, failure count). A field
+    absent from both runs is skipped; absent from the baseline only is
+    noted "new" (new instrumentation is not a regression); present in
+    the baseline but vanished from the current run FAILS — a counter
+    that silently disappears is exactly the kind of regression the
+    registry exists to catch."""
+    cur = entry.get("stats") or {}
+    base = baseline.get("stats") or {}
+    rows: List[List[str]] = []
+    failures = 0
+    for field, rtol, atol in REGRESS_CHECKS:
+        have_cur, have_base = _finite(cur.get(field)), _finite(
+            base.get(field))
+        if not have_cur and not have_base:
+            continue
+        tol_s, status = "-", "ok"
+        if not have_base:
+            status = "new"
+        elif not have_cur:
+            status = "MISSING"
+            failures += 1
+        else:
+            b, c = float(base[field]), float(cur[field])
+            tol = atol + rtol * abs(b)
+            tol_s = _cell(tol)
+            if abs(c - b) > tol:
+                status = "FAIL"
+                failures += 1
+        rows.append([field, _cell(base.get(field)), _cell(cur.get(field)),
+                     tol_s, status])
+    return rows, failures
+
+
+REGRESS_HEADER = ["field", "baseline", "current", "tol", "status"]

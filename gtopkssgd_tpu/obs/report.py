@@ -15,6 +15,11 @@ or gate one against a committed baseline.
                                                         # straggler attribution
     python -m gtopkssgd_tpu.obs.report watch <run>...   # live tail-follow
     python -m gtopkssgd_tpu.obs.report ledger <run>...  # comm model vs measured
+    python -m gtopkssgd_tpu.obs.report history <dir>    # registry trend table
+                                                        # (obs/registry.py)
+    python -m gtopkssgd_tpu.obs.report regress <run> --registry <dir>
+                                                        # current run vs registry
+                                                        # baseline, gate exits
 
 A <run> is a directory containing metrics.jsonl (what --out-dir produces)
 or a path to any .jsonl file of MetricsLogger records. Multi-process runs
@@ -866,6 +871,9 @@ def run_ledger(targets: Sequence[str], json_out: Optional[str] = None,
           f"{bucket_note}  alpha_ms={base['alpha_ms']} "
           f"beta_gbps={base['beta_gbps']} ici_size={base['ici_size']} "
           f"(fit: {base['fit_source']})")
+    prov = _fit_provenance_line(records)
+    if prov:
+        print(prov)
     print(f"predicted comm: {_fmt(base['predicted_comm_ms'])} ms/step")
     # Codec-bytes audit: modeled vs measured wire bytes per rank (the
     # wire_bytes rows carry both sides of the join).
@@ -903,6 +911,105 @@ def run_ledger(targets: Sequence[str], json_out: Optional[str] = None,
             fh.write("\n")
         print(f"wrote {json_out}")
     return 0
+
+
+def _fit_provenance_line(records: Iterable[dict]) -> Optional[str]:
+    """The manifest's stamped comm-model provenance ("which comm model
+    priced this plan"), or None for runs that predate the stamp. Printed
+    by the plan and ledger headers — including when the source is a
+    calib_fit artifact from a previous calibrated run."""
+    man = extract_manifest(records)
+    if man is None or man.get("comm_fit_source") is None:
+        return None
+    return (f"manifest fit: {man['comm_fit_source']} "
+            f"(alpha_ms={man.get('comm_fit_alpha_ms')} "
+            f"beta_gbps={man.get('comm_fit_beta_gbps')})")
+
+
+def run_history(registry_dir: str, config_hash: Optional[str] = None,
+                json_out: Optional[str] = None) -> int:
+    """``history`` subcommand: the registry's cross-run trend table
+    (obs/registry.py runs.jsonl), offline — no live run needed."""
+    from gtopkssgd_tpu.obs import registry as _registry
+
+    entries, bad = _registry.load_registry(registry_dir)
+    if bad:
+        print(f"note: skipped {bad} malformed registry line(s)")
+    if not entries:
+        print(f"history: no registry entries under {registry_dir} "
+              f"(runs append via --registry {registry_dir})")
+        return 1
+    rows = _registry.history_rows(entries, config_hash=config_hash)
+    if not rows:
+        print(f"history: no entries match config_hash={config_hash}")
+        return 1
+    print(f"history: {len(rows)} run(s)"
+          + (f" with config_hash={config_hash}" if config_hash else
+             f" across {len({e.get('config_hash') for e in entries})} "
+             "config(s)"))
+    print(_table(rows, _registry.HISTORY_HEADER))
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump({"entries": entries}, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0
+
+
+def run_regress(run: str, registry_dir: str,
+                allow_mismatch: bool = False,
+                json_out: Optional[str] = None) -> int:
+    """``regress`` subcommand: summarize the run under test from its
+    shards, diff it against the most recent same-config registry entry
+    under REGRESS_CHECKS tolerances. Exit contract matches ``gate``:
+    0 within tolerance, 1 regression, 2 usage (unreadable run, empty
+    registry, or no comparable baseline without --allow-mismatch)."""
+    from gtopkssgd_tpu.obs import registry as _registry
+
+    try:
+        records, bad = load_records(run)
+    except OSError as e:
+        print(f"cannot read {run}: {e}")
+        return 2
+    if bad:
+        print(f"note: skipped {bad} malformed line(s)")
+    entry = _registry.run_summary(records)
+    if entry is None:
+        print("regress: run has no manifest record — nothing to key the "
+              "baseline lookup on")
+        return 2
+    entries, rbad = _registry.load_registry(registry_dir)
+    if rbad:
+        print(f"note: skipped {rbad} malformed registry line(s)")
+    if not entries:
+        print(f"regress: no registry entries under {registry_dir}")
+        return 2
+    baseline = _registry.pick_baseline(entry, entries,
+                                       allow_mismatch=allow_mismatch)
+    if baseline is None:
+        print(f"regress: no registry entry matches config_hash="
+              f"{entry.get('config_hash')} (rerun with --allow-mismatch "
+              "to compare against the newest entry of any config)")
+        return 2
+    if baseline.get("config_hash") != entry.get("config_hash"):
+        print(f"note: baseline config_hash "
+              f"{baseline.get('config_hash')} != run's "
+              f"{entry.get('config_hash')} (--allow-mismatch)")
+    rows, failures = _registry.regress(entry, baseline)
+    print(f"regress: {run} vs registry entry "
+          f"(config={baseline.get('config_hash', '?')}, "
+          f"git={baseline.get('git_sha', '?')})")
+    print(_table(rows, _registry.REGRESS_HEADER))
+    checked = sum(1 for r in rows if r[-1] != "new")
+    print(f"regress: {checked - failures}/{checked} checks passed")
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump({"current": entry, "baseline": baseline,
+                       "failures": failures}, fh, indent=1,
+                      sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 1 if failures else 0
 
 
 def build_gate_argparser() -> argparse.ArgumentParser:
@@ -958,6 +1065,9 @@ def run_plan(run: str, json_out: Optional[str] = None) -> int:
               "runs have no sparse wire to plan; pre-planner runs "
               "predate the record)")
         return 1
+    prov = _fit_provenance_line(records)
+    if prov:
+        print(prov)
     for rec in decisions:
         pin = rec.get("pin", "auto")
         how = f"pinned via --comm-plan {pin}" if pin != "auto" else (
@@ -1126,6 +1236,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_ledger(a.targets, json_out=a.json_out,
                           alpha_ms=a.alpha_ms, beta_gbps=a.beta_gbps,
                           probe_dir=a.probe_dir)
+    if argv and argv[0] == "history":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report history",
+            description="Cross-run trend table from a workspace registry "
+                        "(runs.jsonl appended by --registry; "
+                        "obs/registry.py).")
+        ap.add_argument("registry", help="registry dir holding runs.jsonl")
+        ap.add_argument("--config-hash", default=None,
+                        help="only entries of this manifest config_hash")
+        ap.add_argument("--json", dest="json_out", default=None)
+        a = ap.parse_args(argv[1:])
+        return run_history(a.registry, config_hash=a.config_hash,
+                           json_out=a.json_out)
+    if argv and argv[0] == "regress":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report regress",
+            description="Gate the run under test against the most recent "
+                        "same-config registry entry with per-field rtol "
+                        "drift checks; exit 0 pass / 1 regression / 2 "
+                        "usage, like 'gate'.")
+        ap.add_argument("run", help="an --out-dir or metrics.jsonl path")
+        ap.add_argument("--registry", required=True,
+                        help="registry dir holding runs.jsonl")
+        ap.add_argument("--allow-mismatch", action="store_true",
+                        help="fall back to the newest entry of ANY "
+                             "config_hash when none matches (normally "
+                             "refused: cross-config comparison)")
+        ap.add_argument("--json", dest="json_out", default=None)
+        a = ap.parse_args(argv[1:])
+        return run_regress(a.run, a.registry,
+                           allow_mismatch=a.allow_mismatch,
+                           json_out=a.json_out)
     args = build_argparser().parse_args(argv)
     if len(args.runs) > 2:
         print("at most 2 runs (one to summarize, two to compare)")

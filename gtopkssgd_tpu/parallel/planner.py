@@ -210,22 +210,29 @@ def build_decision(mode: Optional[str], *, p: int, n: int, k: int,
                    beta_gbps: Optional[float] = None,
                    ici_gbps: Optional[float] = None,
                    bucketing: str = "concat",
-                   buckets: Optional[Tuple[Tuple[int, int], ...]] = None
+                   buckets: Optional[Tuple[Tuple[int, int], ...]] = None,
+                   fit_source: Optional[str] = None
                    ) -> PlanDecision:
     """Score every candidate plan for (mode, mesh, n, k, codec) and pick
     one: the pinned plan when ``pin`` names one, else the cheapest under
     the model (stable min — the historical default wins ties). Explicit
     alpha/beta/ici arguments override the probe-artifact lookup (tests,
-    what-if scoring). ``bucketing``/``buckets`` (the resolved --buckets
-    key and the BucketPlan's (n_b, k_b) pairs) make the candidate scores
-    price the bucketed wire — B merges, each over its bucket-local index
-    space — instead of the single concatenated merge."""
+    what-if scoring); ``fit_source`` labels where such an override came
+    from (the --comm-model-fit artifact's filename) in place of the
+    generic "arg", so the decision record keeps real provenance.
+    ``bucketing``/``buckets`` (the resolved --buckets key and the
+    BucketPlan's (n_b, k_b) pairs) make the candidate scores price the
+    bucketed wire — B merges, each over its bucket-local index space —
+    instead of the single concatenated merge."""
     pin = validate_pin(pin, mode, ici_size=ici_size)
     inputs = planner_inputs(probe_dir)
+    override_source = fit_source if fit_source is not None else "arg"
     if alpha_ms is not None:
-        inputs["alpha_ms"], inputs["fit_source"] = float(alpha_ms), "arg"
+        inputs["alpha_ms"] = float(alpha_ms)
+        inputs["fit_source"] = override_source
     if beta_gbps is not None:
-        inputs["beta_gbps"], inputs["fit_source"] = float(beta_gbps), "arg"
+        inputs["beta_gbps"] = float(beta_gbps)
+        inputs["fit_source"] = override_source
     if ici_gbps is not None:
         inputs["ici_gbps"] = float(ici_gbps)
     cands = candidate_plans(mode, codec=codec, ici_size=ici_size,

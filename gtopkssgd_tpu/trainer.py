@@ -249,6 +249,32 @@ class TrainConfig:
                                    # dispatch's last-step loss, same as
                                    # the per-step path reports its last
                                    # step. num_iters must divide.
+    obs_calib: bool = False        # live comm-model calibration
+                                   # (obs/calib.py): profile-attribute a
+                                   # dispatch every obs_calib_interval
+                                   # steps, feed measured (wire_bytes,
+                                   # t_comm) to an online alpha/beta
+                                   # fitter; "calib" records per refit,
+                                   # comm_model_drift rule vs the
+                                   # planner's inputs, end-of-run
+                                   # calib_fit_{P}proc.json artifact in
+                                   # out_dir. Needs obs_counters and
+                                   # nworkers > 1; off by default — each
+                                   # measurement is a profiler capture
+    obs_calib_interval: int = 25   # steps between calibration captures
+    registry: Optional[str] = None  # append this run's summary line to
+                                   # DIR/runs.jsonl on exit
+                                   # (obs/registry.py; read back with
+                                   # `report history` / `report
+                                   # regress`). None disables
+    comm_model_fit: Optional[str] = None  # explicit alpha/beta fit
+                                   # artifact (dcn_probe_*.json or
+                                   # calib_fit_*.json) pricing the comm
+                                   # planner, overriding the probe-dir
+                                   # lookup; the filename is stamped as
+                                   # fit provenance in manifest + plan
+                                   # record. Malformed file fails at
+                                   # startup. None = default lookup
 
     # --- per-dataset defaults (the reference hardcoded these in DLTrainer) --
     def resolved(self) -> "TrainConfig":
@@ -444,6 +470,16 @@ class Trainer:
             self.train_shards[0], cfg.batch_size, cfg.nsteps_update
         )
 
+        # Explicit comm-model fit (--comm-model-fit): loaded once here —
+        # a malformed artifact fails at startup, not mid-run. It prices
+        # the plan decision below and its filename is stamped as fit
+        # provenance; _comm_plan_pin later pins the optimizer's
+        # trace-time resolve_plan to the decision it produced.
+        self._comm_fit = None
+        self._comm_plan_pin = None
+        if cfg.comm_model_fit:
+            from gtopkssgd_tpu.obs.calib import load_fit_file
+            self._comm_fit = load_fit_file(cfg.comm_model_fit)
         self.tx = self._make_tx()
         self.state, self.carry = self._init_state()
         # Layer-name column for "layers" records: index i of every
@@ -478,18 +514,38 @@ class Trainer:
             bplan = self._bucket_plan
             k = (bplan.k_total if bplan is not None
                  else max(1, int(np.ceil(cfg.density * self.num_params))))
+            fit_kw = {}
+            if self._comm_fit is not None:
+                fit_kw = dict(alpha_ms=self._comm_fit["alpha_ms"],
+                              beta_gbps=self._comm_fit["beta_gbps"],
+                              fit_source=self._comm_fit["source"])
             self._plan_decision = build_decision(
                 cfg.compression, p=self.p, n=self.num_params, k=k,
                 codec=cfg.wire_codec, ici_size=cfg.hier_ici,
                 pin=cfg.comm_plan,
                 bucketing=buckets_key(cfg.buckets),
-                buckets=bplan.pairs() if bplan is not None else None)
+                buckets=bplan.pairs() if bplan is not None else None,
+                **fit_kw)
+        if (self._comm_fit is not None and self._plan_decision is not None
+                and self._plan_decision.pin == "auto"):
+            # The optimizer's trace-time resolve_plan only sees the
+            # default probe dir; pin it to the decision the explicit fit
+            # priced, or the wire that runs could disagree with the plan
+            # that was recorded. Same state treedef — comm_plan never
+            # shapes opt state — so the rebuilt tx drops in.
+            self._comm_plan_pin = self._plan_decision.plan.name
+            self.tx = self._make_tx()
         plan_extra = {}
         if self._plan_decision is not None:
             d = self._plan_decision
             plan_extra = {"comm_plan": d.plan.name,
                           "comm_plan_schedule": d.plan.schedule,
-                          "comm_plan_pin": d.pin}
+                          "comm_plan_pin": d.pin,
+                          # which comm model priced this plan — the
+                          # ledger/plan report headers read these back
+                          "comm_fit_source": d.inputs.get("fit_source"),
+                          "comm_fit_alpha_ms": d.inputs.get("alpha_ms"),
+                          "comm_fit_beta_gbps": d.inputs.get("beta_gbps")}
         if self._bucket_plan is not None:
             plan_extra.update(self._bucket_plan.to_manifest())
         # Run-manifest header: first record of every metrics file, so
@@ -497,15 +553,35 @@ class Trainer:
         # mesh, jax/backend versions, git sha). In sharded multi-process
         # runs EVERY rank writes it — config_hash is the join key the
         # fleet merger validates before aligning shards.
-        self.metrics.log("manifest", flush=True, **run_manifest(
+        self._manifest = run_manifest(
             cfg, mesh=self.mesh, num_params=self.num_params,
-            steps_per_epoch=self.steps_per_epoch, **plan_extra))
+            steps_per_epoch=self.steps_per_epoch, **plan_extra)
+        self.metrics.log("manifest", flush=True, **self._manifest)
         if self._plan_decision is not None:
             self.metrics.log("plan", flush=True,
                              **self._plan_decision.record())
         if self._bucket_plan is not None:
             self.metrics.log("bucket", flush=True,
                              **self._bucket_record())
+        # Live comm-model calibrator (obs/calib.py): fed measured
+        # (wire_bytes, t_comm) from the profiler-attributed dispatches in
+        # train(); its drift baseline is the EXACT inputs that priced
+        # this run's plan. p == 1 has no wire to calibrate.
+        self.calib = None
+        if cfg.obs_calib and cfg.obs_counters and self.p > 1:
+            from gtopkssgd_tpu.obs.calib import CommCalibrator
+            d = self._plan_decision
+            if d is not None:
+                wire_mode = d.plan.wire_mode
+                inputs = d.inputs
+            else:
+                from gtopkssgd_tpu.parallel.planner import planner_inputs
+                wire_mode, inputs = "dense", planner_inputs(None)
+            self.calib = CommCalibrator(
+                wire_mode, self.p,
+                baseline={key: inputs.get(key) for key in
+                          ("alpha_ms", "beta_gbps", "fit_source")},
+                metrics=self.metrics, monitor=self.monitor)
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
         # Degrade fallback (recover-policy "degrade"): the sparse step
@@ -572,6 +648,37 @@ class Trainer:
             "beta_gbps": beta,
         }
 
+    def _feed_calibrator(self, step: int, spd: int,
+                         trace_dir: str) -> None:
+        """Attribute the just-captured dispatch and feed one measured
+        (wire_bytes, t_comm_ms) sample to the comm calibrator. Wire
+        bytes come from the same on-device telemetry the obs records
+        read; t_comm from the profiler attribution, normalized per
+        optimizer step. Attribution failure degrades to a warning — a
+        missed sample must never take down training. AnomalyHalt from
+        the drift rule propagates like any monitor halt."""
+        import shutil
+
+        from gtopkssgd_tpu.obs.trace_attr import attribute
+        try:
+            rec = attribute(trace_dir, mode=self.cfg.compression)
+        except Exception as e:
+            self.logger.warning("calib attribution failed: %s", e)
+            return
+        finally:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+        t_comm_us = rec.get("t_comm_us")
+        if not isinstance(t_comm_us, (int, float)) or t_comm_us <= 0:
+            return
+        tel = self.state.opt_state.telemetry
+        if not tel:
+            return
+        wire = float(telemetry_scalars(tel).get("wire_bytes", 0.0))
+        if wire <= 0:
+            return
+        self.calib.observe(step, wire_bytes=wire,
+                           t_comm_ms=float(t_comm_us) / 1e3 / spd)
+
     def _make_tx(self, warmup_dense_steps: Optional[int] = None):
         """The optimizer transform; ``warmup_dense_steps`` overrides the
         config-derived value (the degrade fallback passes 2**30 to pin
@@ -590,7 +697,7 @@ class Trainer:
             density=cfg.density,
             topk_method=cfg.topk_method,
             wire_codec=cfg.wire_codec,
-            comm_plan=cfg.comm_plan,
+            comm_plan=self._comm_plan_pin or cfg.comm_plan,
             buckets=cfg.buckets,
             clip_grad_norm=cfg.clip_grad_norm,
             axis_name="dp" if self.p > 1 else None,
@@ -664,6 +771,33 @@ class Trainer:
                 self.logger.info("timeline -> %s", path)
             except OSError as e:
                 self.logger.warning("timeline write failed: %s", e)
+        # End-of-run calibration artifact: the dcn_probe-compatible fit
+        # the NEXT run's planner_inputs can consume (copy into the probe
+        # dir or pass via --comm-model-fit). Before metrics.close — the
+        # registry summary below reads the stream back.
+        if (getattr(self, "calib", None) is not None and self.cfg.out_dir
+                and self.process_rank == 0):
+            try:
+                path = self.calib.write_artifact(
+                    self.cfg.out_dir, manifest=self._manifest)
+                if path:
+                    self.logger.info("comm-model fit -> %s", path)
+            except OSError as e:
+                self.logger.warning("calib artifact write failed: %s", e)
+        if self.cfg.registry and self.cfg.out_dir and self.process_rank == 0:
+            # One summary line per run into the workspace registry
+            # (obs/registry.py) — read back offline with `report
+            # history` / `report regress`.
+            try:
+                from gtopkssgd_tpu.obs import registry as _registry
+                from gtopkssgd_tpu.obs.report import load_records
+                records, _bad = load_records(self.cfg.out_dir)
+                entry = _registry.run_summary(records)
+                if entry is not None:
+                    path = _registry.append_run(self.cfg.registry, entry)
+                    self.logger.info("registry += %s", path)
+            except (OSError, ValueError) as e:
+                self.logger.warning("registry append failed: %s", e)
         # The metrics file outlives close() (restore() can resume a closed
         # Trainer's training); only leaving the context ends the run.
         self.metrics.close()
@@ -1171,16 +1305,37 @@ class Trainer:
                 if inj is not None:
                     self.state = inj.poison_params(
                         self.state, step, step + spd)
+                calib_now = (
+                    self.calib is not None and cfg.obs_calib_interval > 0
+                    and (step + spd) % cfg.obs_calib_interval < spd)
                 with self.tracer.span("dispatch"):
                     # Async enqueue only — the span must NOT drain the
                     # queue (the overlap is the point); device time shows
                     # under the same name in a profiler trace.
-                    self.state, self.carry, loss, aux = self._train_step(
-                        self.state, self.carry, batch
-                    )
+                    if calib_now:
+                        # Calibration sample: profile exactly this
+                        # dispatch, blocking inside the capture so the
+                        # device comm events land in the trace — a sync
+                        # plus profiler overhead, which is why the
+                        # cadence is opt-in (obs_calib_interval).
+                        import tempfile
+
+                        from gtopkssgd_tpu.obs.trace_attr import capture
+                        trace_dir = tempfile.mkdtemp(prefix="calib_trace_")
+                        with capture(trace_dir):
+                            self.state, self.carry, loss, aux = (
+                                self._train_step(self.state, self.carry,
+                                                 batch))
+                            jax.block_until_ready(loss)
+                    else:
+                        self.state, self.carry, loss, aux = self._train_step(
+                            self.state, self.carry, batch
+                        )
                 samples += (cfg.batch_size * cfg.nworkers
                             * cfg.nsteps_update * spd)
                 step += spd
+                if calib_now:
+                    self._feed_calibrator(step, spd, trace_dir)
                 if inj is not None:
                     # preempt injection delivers a real SIGTERM through
                     # the installed guard; the flag check right after

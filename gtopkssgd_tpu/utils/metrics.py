@@ -68,6 +68,12 @@ KINDS = frozenset({
                    # per-bucket k, modeled ms for B in {1, chosen, L});
                    # the gate smoke logs the bucketed-vs-leafwise A/B
                    # (collective-count ratio, audited recall, bytes ratio)
+    "calib",       # live comm-model refit (obs/calib.py): fitted
+                   # alpha/beta, residual spread, n_samples, drift vs
+                   # the planner's committed inputs and the startup fit
+    "regress",     # cross-run regression evidence row (gate smoke):
+                   # registry regress exit codes + fitted-vs-true check
+                   # against obs/registry.py's runs.jsonl baseline
 })
 
 _SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")
