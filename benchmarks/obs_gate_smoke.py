@@ -531,6 +531,99 @@ def run_calib_smoke(out_dir: str) -> dict:
     }
 
 
+def run_mem_smoke(out_dir: str) -> dict:
+    """Compile/memory-plane smoke (the ISSUE-14 tentpole's consumer):
+    two instrumented sub-runs of the canonical model under ``--obs-mem``
+    (both reuse the persistent compile cache), returning the fields the
+    main run logs as ONE "mem" record:
+
+      clean leg (4 steps)        mem_rc==0; exactly ONE "compile" record
+                                 (one dispatch shape for the whole run —
+                                 the committed-at-init sharding fix);
+                                 recompile_count pinned at 0 after
+                                 warmup; live-bytes stable across the
+                                 sampled windows; peak_hbm_bytes in the
+                                 manifest, equal to the compile record's
+                                 estimate, and carried into the registry
+                                 line (regress vs itself exits 0);
+                                 ``report mem`` / ``report compile``
+                                 round-trip the records (exit 0)
+      storm leg (reshape@3)      the injected second dispatch shape
+                                 retraces the step: recompile_count
+                                 lands at exactly 1, recompile_storm
+                                 fires with warmup 0, --obs-halt-on
+                                 warn exits 44 — with BOTH shapes'
+                                 compile accounting on disk before the
+                                 halt (record-before-rule)"""
+    import json as _json
+
+    from gtopkssgd_tpu import dist_trainer
+    from gtopkssgd_tpu.obs import report
+    from gtopkssgd_tpu.obs import registry as _registry
+
+    canon = [
+        "--dnn", "resnet20", "--batch-size", "4", "--nworkers", "2",
+        "--compression", "gtopk_layerwise", "--density", "0.01",
+        "--seed", "42", "--eval-batches", "1", "--log-interval", "1",
+        "--obs-interval", "1", "--obs-mem", "--obs-mem-interval", "1",
+    ]
+
+    def _recs(d):
+        with open(os.path.join(d, "metrics.jsonl")) as fh:
+            return [_json.loads(line) for line in fh]
+
+    mem_dir = os.path.join(out_dir, "memwatch")
+    reg_dir = os.path.join(out_dir, "mem_registry")
+    mem_rc = dist_trainer.main(canon + [
+        "--num-iters", "4", "--registry", reg_dir, "--out-dir", mem_dir])
+    recs = _recs(mem_dir)
+    manifest = next(r for r in recs if r["kind"] == "manifest")
+    shapes = [r for r in recs if r["kind"] == "compile"
+              and r.get("event") is None]
+    mems = [r for r in recs if r["kind"] == "mem"]
+    live = [r["live_bytes"] for r in mems if r.get("live_bytes")]
+    peak = manifest.get("peak_hbm_bytes", 0) or 0
+    peak_matches = (len(shapes) == 1
+                    and shapes[0].get("peak_hbm_bytes") == peak)
+    entries, _bad = _registry.load_registry(reg_dir)
+    reg_stats = (entries[-1].get("stats", {}) if entries else {})
+    reg_has_fields = ("peak_hbm_bytes" in reg_stats
+                      and "recompile_count" in reg_stats)
+
+    storm_dir = os.path.join(out_dir, "memstorm")
+    storm_rc = dist_trainer.main(canon + [
+        "--num-iters", "5", "--inject", "reshape@3",
+        "--obs-recompile-warmup", "0", "--obs-halt-on", "warn",
+        "--out-dir", storm_dir])
+    storm_recs = _recs(storm_dir)
+    storm_recompiles = [r for r in storm_recs if r["kind"] == "compile"
+                        and r.get("event") == "recompile"]
+    storm_shapes = [r for r in storm_recs if r["kind"] == "compile"
+                    and r.get("event") is None]
+    storm_events = [r for r in storm_recs if r["kind"] == "event"
+                    and r.get("rule") == "recompile_storm"]
+    return {
+        "mem_rc": float(mem_rc),
+        "compile_records": float(len(shapes)),
+        "recompile_count": float(max(
+            (r.get("recompile_count", 0) for r in mems), default=0)),
+        "mem_samples": float(len(mems)),
+        "live_ratio": (max(live) / min(live)) if live else 0.0,
+        "peak_hbm_bytes": float(peak),
+        "peak_matches_compile": 1.0 if peak_matches else 0.0,
+        "registry_has_mem_fields": 1.0 if reg_has_fields else 0.0,
+        "mem_report_rc": float(report.run_mem(mem_dir)),
+        "compile_report_rc": float(report.run_compile(mem_dir)),
+        "mem_regress_rc": float(report.run_regress(mem_dir, reg_dir)),
+        "storm_rc": float(storm_rc),
+        "storm_recompile_count": float(
+            max((r.get("recompile_count", 0) for r in storm_recompiles),
+                default=0)),
+        "storm_events": float(len(storm_events)),
+        "storm_shapes": float(len(storm_shapes)),
+    }
+
+
 def run_smoke(out_dir: str) -> str:
     """Train the canonical run; returns the run dir (metrics.jsonl inside).
 
@@ -566,6 +659,7 @@ def run_smoke(out_dir: str) -> str:
     plan_rec = run_plan_smoke(out_dir, codec_rec)
     bucket_rec = run_bucket_smoke(out_dir)
     calib_rec = run_calib_smoke(out_dir)
+    mem_rec = run_mem_smoke(out_dir)
 
     cfg = smoke_config(out_dir)
     with Trainer(cfg) as t:
@@ -628,6 +722,12 @@ def run_smoke(out_dir: str) -> str:
             k: v for k, v in calib_rec.items() if k not in _regress_keys})
         t.metrics.log("regress", flush=True, **{
             k: v for k, v in calib_rec.items() if k in _regress_keys})
+        # And the compile/memory-plane smoke: one-executable discipline
+        # on the clean leg (recompile_count 0, one compile record, the
+        # manifest's peak-HBM matched and registry-carried) and the full
+        # storm chain on the chaos leg (reshape -> retrace -> exactly
+        # one recompile -> exit 44).
+        t.metrics.log("mem", **mem_rec)
         # Static-analysis gate: run graftlint in-process over the
         # package + benchmarks against the committed repo baseline and
         # record the counts; the gate pins non_baselined at exactly 0,

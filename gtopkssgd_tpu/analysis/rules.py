@@ -505,7 +505,8 @@ class DurableEventRule:
 
     name = "durable-event"
 
-    DURABLE_KINDS = {"event", "inject", "recovery", "calib", "regress"}
+    DURABLE_KINDS = {"event", "inject", "recovery", "calib", "regress",
+                     "compile"}
 
     def run(self, files: Sequence[SourceFile]) -> List[Finding]:
         findings: List[Finding] = []

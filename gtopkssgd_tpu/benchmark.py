@@ -40,6 +40,7 @@ from gtopkssgd_tpu.parallel import (
     sparse_allreduce,
 )
 from gtopkssgd_tpu.obs import Tracer
+from gtopkssgd_tpu.obs.memwatch import compiled_flops
 from gtopkssgd_tpu.utils import (
     safe_donate,
     sync_round_trip_seconds,
@@ -91,16 +92,11 @@ def _peak_flops_per_chip() -> Optional[float]:
     return None
 
 
-def _compiled_flops(compiled) -> Optional[float]:
-    """Per-step FLOPs as XLA counts them (cost_analysis), None if absent."""
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        flops = float(cost.get("flops", -1.0))
-        return flops if flops > 0 else None
-    except Exception:
-        return None
+# Per-step FLOPs for MFU come from the SAME cost_analysis extraction
+# path as the obs "compile" records (obs/memwatch.py) — one normalizer
+# for the dict/list return-shape drift across jax versions, so bench
+# and obs can never disagree on what XLA counted.
+_compiled_flops = compiled_flops
 
 
 def _setup(cfg: BenchConfig, mode: Optional[str], density: float):

@@ -150,6 +150,32 @@ reference's only telemetry was text logs):
                                          each sample costs a capture)
     --obs-calib-interval N               steps between calibration
                                          captures (default 25)
+    --obs-mem / --no-obs-mem             compile/memory-plane watch
+                                         (obs.memwatch): AOT compile
+                                         accounting — one fsync'd
+                                         "compile" record per distinct
+                                         dispatch shape, peak-HBM
+                                         estimate stamped into the
+                                         manifest — plus jit-cache
+                                         recompile tracking
+                                         (recompile_storm rule) and
+                                         sampled live-memory "mem"
+                                         records feeding the
+                                         device_mem_leak / hbm_headroom
+                                         rules (default off — costs one
+                                         AOT compile per dispatch shape)
+    --obs-mem-interval N                 steps between live-memory
+                                         samples (default 50)
+    --obs-recompile-warmup N             compile-watch polls before
+                                         recompile_storm arms (default
+                                         1; 0 = any cache growth fires)
+    --obs-mem-leak-windows K             consecutive growing live-bytes
+                                         windows before device_mem_leak
+                                         fires (default 3)
+    --obs-hbm-headroom-frac F            bytes_in_use/bytes_limit
+                                         fraction above which
+                                         hbm_headroom fires (default
+                                         0.92)
     --registry DIR                       append one summary line per run
                                          to DIR/runs.jsonl (obs.registry:
                                          manifest header + steps/sec,
@@ -173,7 +199,9 @@ detect-and-recover):
                                          injection (nan_grad@K,
                                          slow_rank:R:DURs@A-B,
                                          loader_raise@K, preempt@K,
-                                         corrupt_ckpt@latest)
+                                         corrupt_ckpt@latest, reshape@K
+                                         — a changed dispatch shape
+                                         that forces a retrace)
     --recover-policy POLICY              rule=action[:budget[:param]] maps
                                          anomaly rules to skip / rollback /
                                          degrade instead of exit 44
@@ -385,6 +413,32 @@ def build_argparser() -> argparse.ArgumentParser:
                         "each sample costs a profiler capture + sync")
     p.add_argument("--obs-calib-interval", type=int, default=25,
                    help="optimizer steps between calibration captures")
+    p.add_argument("--obs-mem", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="compile/memory-plane watch (obs.memwatch): AOT "
+                        "compile accounting — one fsync'd 'compile' record "
+                        "per distinct dispatch shape (cost/memory analysis, "
+                        "lower/compile wall times) with the peak-HBM "
+                        "estimate stamped into the manifest — plus jit "
+                        "executable-cache recompile tracking (the "
+                        "recompile_storm rule) and sampled live-memory "
+                        "'mem' records (jax.live_arrays + per-device "
+                        "memory_stats) feeding device_mem_leak / "
+                        "hbm_headroom. Opt-in: costs one ahead-of-time "
+                        "compile per dispatch shape at startup")
+    p.add_argument("--obs-mem-interval", type=int, default=50,
+                   help="optimizer steps between live-memory samples")
+    p.add_argument("--obs-recompile-warmup", type=int, default=1,
+                   help="compile-watch polls before recompile_storm arms "
+                        "(0 fires on any executable-cache growth; the "
+                        "default tolerates the first real dispatch)")
+    p.add_argument("--obs-mem-leak-windows", type=int, default=3,
+                   help="consecutive growing live-memory windows before "
+                        "device_mem_leak fires")
+    p.add_argument("--obs-hbm-headroom-frac", type=float, default=0.92,
+                   help="bytes_in_use/bytes_limit fraction above which "
+                        "hbm_headroom fires (backends without "
+                        "memory_stats never trip it)")
     p.add_argument("--registry", default=None, metavar="DIR",
                    help="append this run's summary line (manifest subset "
                         "+ steps/sec, comm ratio, fitted alpha/beta, "
@@ -408,8 +462,10 @@ def build_argparser() -> argparse.ArgumentParser:
                         "step on rank 2; loader_raise@75 raises from the "
                         "data loader; preempt@200 delivers SIGTERM; "
                         "corrupt_ckpt@latest truncates the newest "
-                        "checkpoint before restore. Deterministic, so "
-                        "chaos runs reproduce in CI")
+                        "checkpoint before restore; reshape@9 halves the "
+                        "batch axis of step 9's host batch (forces a "
+                        "retrace — recompile-storm chaos). Deterministic, "
+                        "so chaos runs reproduce in CI")
     p.add_argument("--recover-policy", default=None, metavar="POLICY",
                    help="map anomaly rules to recovery actions instead of "
                         "exit 44 (grammar rule=action[:budget[:param]], "
@@ -483,6 +539,11 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         obs_export_port=args.obs_export_port,
         obs_calib=args.obs_calib,
         obs_calib_interval=args.obs_calib_interval,
+        obs_mem=args.obs_mem,
+        obs_mem_interval=args.obs_mem_interval,
+        obs_recompile_warmup=args.obs_recompile_warmup,
+        obs_mem_leak_windows=args.obs_mem_leak_windows,
+        obs_hbm_headroom_frac=args.obs_hbm_headroom_frac,
         registry=args.registry,
         comm_model_fit=args.comm_model_fit,
         inject=args.inject,

@@ -70,6 +70,15 @@ residual dynamics). This package turns the repo's scattered primitives
       comm_model_drift anomaly rule, and writes a dcn_probe-compatible
       calib_fit_{P}proc.json artifact at end of run that the planner
       consumes next run — the obs->planner loop, closed.
+  memwatch.py — compile- and memory-plane watch (``--obs-mem``): AOT
+      compile accounting (one fsync'd "compile" record per distinct
+      dispatch shape — cost/memory analysis, lower/compile wall times,
+      peak-HBM estimate stamped into the manifest; benchmark.py's MFU
+      consumes the same cost extraction), a jit executable-cache
+      recompile watch feeding the recompile_storm rule, and sampled
+      live-memory "mem" records (jax.live_arrays + per-device
+      memory_stats where the backend exposes them) feeding the
+      device_mem_leak / hbm_headroom rules.
   registry.py — append-only cross-run registry (``--registry DIR``):
       one runs.jsonl line per run (manifest subset + steps/sec, comm
       ratio, fitted alpha/beta, recall floor, wire bytes/step); read
@@ -119,6 +128,14 @@ from gtopkssgd_tpu.obs.manifest import (
     git_sha,
     run_manifest,
 )
+from gtopkssgd_tpu.obs.memwatch import (
+    CompileWatch,
+    MemWatch,
+    batch_shape_key,
+    compiled_flops,
+    cost_summary,
+    memory_summary,
+)
 from gtopkssgd_tpu.obs.timeline import (
     TimelineRecorder,
     timeline_from_records,
@@ -134,16 +151,22 @@ __all__ = [
     "AnomalyHalt",
     "AnomalyMonitor",
     "CommCalibrator",
+    "CompileWatch",
+    "MemWatch",
     "MetricsExporter",
     "Thresholds",
     "TimelineRecorder",
     "Tracer",
     "StallWatchdog",
+    "batch_shape_key",
+    "compiled_flops",
     "config_hash",
     "coordinator_address",
+    "cost_summary",
     "fit_alpha_beta",
     "git_sha",
     "keep_tau",
+    "memory_summary",
     "layer_names",
     "load_fit_file",
     "make_telemetry",

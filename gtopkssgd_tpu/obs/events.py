@@ -41,6 +41,24 @@ synced (no extra device reads):
                                   longer describes the fabric. Fed by
                                   CommCalibrator.refit through
                                   ``observe_comm_model``
+  recompile_storm       warn      the jitted step's executable cache
+                                  grew after ``recompile_warmup`` prior
+                                  polls — a drifting dispatch shape is
+                                  retracing the hot step every few
+                                  dispatches. Fed by obs/memwatch.py's
+                                  CompileWatch through
+                                  ``observe_compile``
+  device_mem_leak       warn      sampled live-array bytes grew across
+                                  ``mem_leak_windows`` CONSECUTIVE
+                                  windows (a plateau resets the streak;
+                                  fires once per monotonic run). Fed by
+                                  the live-memory watch (obs/memwatch)
+                                  through ``observe_memory``
+  hbm_headroom          warn      device bytes_in_use crossed
+                                  ``hbm_headroom_frac`` of bytes_limit
+                                  (fires on the crossing; re-arms when
+                                  usage drops back under). Same feed as
+                                  device_mem_leak
 
 Each firing emits one severity-tagged ``event`` record through
 MetricsLogger with ``flush=True`` (fsync'd — a run killed one line later
@@ -94,6 +112,14 @@ class Thresholds:
     comm_drift_x: float = 4.0        # live fit vs planner inputs, either
                                      # direction (max of a/b and b/a)
     comm_drift_warmup: int = 2       # refits before the drift rule arms
+    recompile_warmup: int = 1        # compile-watch polls before
+                                     # recompile_storm arms (0 = any
+                                     # cache growth fires, even the
+                                     # first poll's)
+    mem_leak_windows: int = 3        # consecutive growing live-bytes
+                                     # windows before device_mem_leak
+    hbm_headroom_frac: float = 0.92  # bytes_in_use / bytes_limit above
+                                     # which hbm_headroom fires
 
     def age_max(self, rho: Optional[float]) -> float:
         if self.residual_age_max > 0:
@@ -160,6 +186,16 @@ class AnomalyMonitor:
         # Refits seen so far, fed by the comm calibrator — the drift
         # rule arms only after comm_drift_warmup prior refits.
         self._comm_fit_n = 0
+        # Compile-plane state (observe_compile): polls seen so far —
+        # recompile_storm arms only after recompile_warmup prior polls.
+        self._compile_n = 0
+        # Memory-plane state (observe_memory): last live-bytes sample,
+        # the current growth streak, and the per-rule latches (leak
+        # fires once per monotonic run; headroom once per crossing).
+        self._mem_last: Optional[float] = None
+        self._mem_grow = 0
+        self._mem_leak_fired = False
+        self._headroom_over = False
 
     # ---------------------------------------------------------- the rules
     def _check(self, step: int, loss: Optional[float],
@@ -301,6 +337,76 @@ class AnomalyMonitor:
             self._comm_fit_n += 1
         return out
 
+    # ---------------------------------------------- compile plane (memwatch)
+    def _check_compile(self, step: int, cache_size: Optional[int],
+                       grew: bool) -> List[Dict[str, Any]]:
+        th = self.th
+        out: List[Dict[str, Any]] = []
+        # Arm-before-update, like the drift rule: growth observed within
+        # the first recompile_warmup polls is warm-up compilation (a new
+        # dispatch shape the run was always going to trace), not a storm.
+        if grew and self._compile_n >= th.recompile_warmup:
+            out.append({
+                "rule": "recompile_storm", "severity": "warn",
+                "step": step,
+                "value": (round(float(cache_size), 6)
+                          if _finite(cache_size) else None),
+                "threshold": round(float(th.recompile_warmup), 6),
+                "message": (f"jit executable cache grew to {cache_size} "
+                            f"entries at step {step} after "
+                            f"{self._compile_n} warm polls — a drifting "
+                            "dispatch shape is retracing the hot step"),
+            })
+        self._compile_n += 1
+        return out
+
+    # ----------------------------------------------- memory plane (memwatch)
+    def _check_memory(self, step: int, live_bytes: Optional[float],
+                      bytes_in_use: Optional[float],
+                      bytes_limit: Optional[float]
+                      ) -> List[Dict[str, Any]]:
+        th = self.th
+        out: List[Dict[str, Any]] = []
+        if _finite(live_bytes):
+            if self._mem_last is not None and live_bytes > self._mem_last:
+                self._mem_grow += 1
+            else:
+                # A plateau or shrink resets both the streak and the
+                # latch — the NEXT monotonic run may fire again.
+                self._mem_grow = 0
+                self._mem_leak_fired = False
+            self._mem_last = float(live_bytes)
+            if (self._mem_grow >= th.mem_leak_windows
+                    and not self._mem_leak_fired):
+                self._mem_leak_fired = True
+                out.append({
+                    "rule": "device_mem_leak", "severity": "warn",
+                    "step": step, "value": round(float(live_bytes), 6),
+                    "threshold": round(float(th.mem_leak_windows), 6),
+                    "message": (f"live device bytes grew for "
+                                f"{self._mem_grow} consecutive windows "
+                                f"to {live_bytes:.4g} — buffers are "
+                                "accumulating (leak or unbounded cache)"),
+                })
+        if (_finite(bytes_in_use) and _finite(bytes_limit)
+                and bytes_limit > 0):
+            frac = float(bytes_in_use) / float(bytes_limit)
+            if frac > th.hbm_headroom_frac:
+                if not self._headroom_over:
+                    self._headroom_over = True
+                    out.append({
+                        "rule": "hbm_headroom", "severity": "warn",
+                        "step": step, "value": round(frac, 6),
+                        "threshold": round(th.hbm_headroom_frac, 6),
+                        "message": (f"device memory {frac:.1%} of "
+                                    f"bytes_limit exceeds "
+                                    f"{th.hbm_headroom_frac:.0%} — the "
+                                    "next allocation spike can OOM"),
+                    })
+            else:
+                self._headroom_over = False
+        return out
+
     # ------------------------------------------------------------- public
     def _emit(self, fired: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         """Record, persist (fsync'd), mark on the timeline, and — after
@@ -361,6 +467,28 @@ class AnomalyMonitor:
         return self._emit(self._check_comm_model(
             step, alpha_ms, beta_gbps, ref_alpha_ms, ref_beta_gbps,
             fit_source))
+
+    def observe_compile(self, step: int, *,
+                        cache_size: Optional[int] = None,
+                        grew: bool = False) -> List[Dict[str, Any]]:
+        """Evaluate the recompile_storm rule against one compile-watch
+        poll (obs/memwatch.py): the jitted step's executable-cache size
+        and whether it grew since the previous poll. Same emit/halt
+        contract as observe — a recompile storm trips --obs-halt-on warn
+        like any other anomaly."""
+        return self._emit(self._check_compile(step, cache_size, grew))
+
+    def observe_memory(self, step: int, *,
+                       live_bytes: Optional[float] = None,
+                       bytes_in_use: Optional[float] = None,
+                       bytes_limit: Optional[float] = None
+                       ) -> List[Dict[str, Any]]:
+        """Evaluate the device_mem_leak / hbm_headroom rules against one
+        live-memory window (obs/memwatch.py sampling). Backends without
+        memory_stats feed live_bytes only — the headroom rule simply
+        never arms there. Same emit/halt contract as observe."""
+        return self._emit(self._check_memory(step, live_bytes,
+                                             bytes_in_use, bytes_limit))
 
     def summary(self) -> Dict[str, int]:
         """{rule: count} over the monitor's lifetime (test/report aid)."""

@@ -43,7 +43,12 @@ _MANIFEST_KEYS = ("config_hash", "git_sha", "dnn", "dataset",
 # live comm_model_drift rule exists for — the registry catches the
 # slow cross-run creep); wire bytes/step is deterministic (10% covers
 # codec padding jitter only); recall floor gets an absolute slack so a
-# floor of 0.0 doesn't make the check vacuous.
+# floor of 0.0 doesn't make the check vacuous. The two memwatch fields
+# (--obs-mem runs only) are the space plane: peak-HBM is an analytical
+# estimate that moves only when the program or its sharding does (10%
+# covers XLA temp-allocation jitter across compiler versions), and
+# recompile_count is exact — ANY cross-run change in how often the jit
+# cache grew under the same config is a regression.
 REGRESS_CHECKS: Tuple[Tuple[str, float, float], ...] = (
     ("steps_per_sec", 0.25, 0.0),
     ("loss_last", 0.25, 0.0),
@@ -52,6 +57,8 @@ REGRESS_CHECKS: Tuple[Tuple[str, float, float], ...] = (
     ("beta_gbps", 1.00, 0.0),
     ("recall_floor", 0.25, 0.05),
     ("wire_bytes_per_step", 0.10, 0.0),
+    ("peak_hbm_bytes", 0.10, 0.0),
+    ("recompile_count", 0.0, 0.0),
 )
 
 
@@ -86,6 +93,8 @@ def run_summary(records: Sequence[Dict[str, Any]]
     recall_floor = None
     wire_sum, wire_n = 0.0, 0
     ratio_sum, ratio_n = 0.0, 0
+    saw_memwatch = False
+    recompile_count = 0
     for rec in records:
         kind = rec.get("kind")
         if kind == "manifest" and manifest is None:
@@ -94,6 +103,13 @@ def run_summary(records: Sequence[Dict[str, Any]]
             trains.append(rec)
         elif kind == "calib":
             last_calib = rec
+        elif kind in ("compile", "mem"):
+            # memwatch (--obs-mem) was on; recompile_count stays an
+            # explicit 0 in that case so regress can pin it exactly.
+            saw_memwatch = True
+            if _finite(rec.get("recompile_count")):
+                recompile_count = max(recompile_count,
+                                      int(rec["recompile_count"]))
         elif kind == "obs":
             recall = rec.get("audit_recall")
             if _finite(recall) and recall >= 0:
@@ -142,6 +158,10 @@ def run_summary(records: Sequence[Dict[str, Any]]
         stats["recall_floor"] = round(float(recall_floor), 6)
     if wire_n:
         stats["wire_bytes_per_step"] = round(wire_sum / wire_n, 2)
+    if _finite(manifest.get("peak_hbm_bytes")):
+        stats["peak_hbm_bytes"] = manifest["peak_hbm_bytes"]
+    if saw_memwatch:
+        stats["recompile_count"] = recompile_count
     if final_status is not None:
         stats["final_status"] = final_status
     entry["stats"] = stats
@@ -209,6 +229,8 @@ def history_rows(entries: Sequence[Dict[str, Any]],
             _cell(stats.get("beta_gbps")),
             _cell(stats.get("recall_floor")),
             _cell(stats.get("wire_bytes_per_step")),
+            _cell(stats.get("peak_hbm_bytes")),
+            _cell(stats.get("recompile_count")),
             str(stats.get("final_status", "-")),
         ])
     return rows
@@ -216,7 +238,7 @@ def history_rows(entries: Sequence[Dict[str, Any]],
 
 HISTORY_HEADER = ["config", "git", "steps", "steps/s", "loss",
                   "comm_ratio", "alpha_ms", "beta_gbps", "recall",
-                  "wireB/step", "status"]
+                  "wireB/step", "peak_hbm", "recomp", "status"]
 
 
 def pick_baseline(entry: Dict[str, Any],

@@ -20,6 +20,11 @@ or gate one against a committed baseline.
     python -m gtopkssgd_tpu.obs.report regress <run> --registry <dir>
                                                         # current run vs registry
                                                         # baseline, gate exits
+    python -m gtopkssgd_tpu.obs.report compile <run>    # per-shape AOT compile
+                                                        # log + recompile watch
+    python -m gtopkssgd_tpu.obs.report mem <run>        # live-memory footprint,
+                                                        # compile log, leak/
+                                                        # headroom summary
 
 A <run> is a directory containing metrics.jsonl (what --out-dir produces)
 or a path to any .jsonl file of MetricsLogger records. Multi-process runs
@@ -819,6 +824,14 @@ def run_watch(targets: Sequence[str], interval: float = 2.0,
                     for key in ("loss", "achieved_density", "wire_bytes"):
                         if isinstance(latest.get(key), (int, float)):
                             bits.append(f"{key}={_fmt(latest[key])}")
+                mem = last.get("mem")
+                if mem is not None:
+                    # space-plane gauges (--obs-mem): same fields the
+                    # OpenMetrics exporter serves as gtopk_mem_*.
+                    for key in ("live_bytes", "bytes_in_use",
+                                "recompile_count"):
+                        if isinstance(mem.get(key), (int, float)):
+                            bits.append(f"{key}={_fmt(mem[key])}")
                 ev = last.get("event")
                 if ev is not None:
                     bits.append(f"last_event={ev.get('rule')}")
@@ -1068,6 +1081,13 @@ def run_plan(run: str, json_out: Optional[str] = None) -> int:
     prov = _fit_provenance_line(records)
     if prov:
         print(prov)
+    # The space plane next to the time plane: when the run carried
+    # --obs-mem, say what the chosen plan costs in HBM.
+    comp = summarize_compile(records)
+    if comp["peak_hbm_bytes"] is not None:
+        print(f"memory: peak-HBM estimate {_fmt(comp['peak_hbm_bytes'])} "
+              f"bytes over {len(comp['shapes'])} dispatch shape(s) "
+              "(obs.memwatch compile records)")
     for rec in decisions:
         pin = rec.get("pin", "auto")
         how = f"pinned via --comm-plan {pin}" if pin != "auto" else (
@@ -1113,6 +1133,279 @@ def run_plan(run: str, json_out: Optional[str] = None) -> int:
         with open(json_out, "w") as fh:
             json.dump({"decisions": decisions, "buckets": bucket_recs},
                       fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0
+
+
+# Fields a compile-shape row carries into summaries and the JSON dump.
+_COMPILE_ROW_FIELDS = (
+    "step", "shape_index", "shape_key", "flops", "bytes_accessed",
+    "temp_bytes", "argument_bytes", "output_bytes", "generated_code_bytes",
+    "peak_hbm_bytes", "lower_s", "compile_s")
+
+# The memory-plane anomaly rules (obs/events.py) the mem report calls out.
+_MEM_RULES = ("recompile_storm", "device_mem_leak", "hbm_headroom")
+
+
+def summarize_compile(records: Iterable[dict]) -> dict:
+    """Compile-plane view over one run's records: the per-shape AOT
+    accounting ("compile" records, obs/memwatch.py), the cache-growth
+    events the recompile watch caught, and the derived peak-HBM estimate
+    the manifest carries."""
+    out = {
+        "shapes": [],          # one row per distinct dispatch shape
+        "recompiles": [],      # jit executable-cache growth events
+        "recompile_count": 0,
+        "peak_hbm_bytes": None,
+        "total_lower_s": None,
+        "total_compile_s": None,
+        "manifest_peak_hbm_bytes": None,
+        "storm_events": 0,
+    }
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "manifest":
+            if isinstance(rec.get("peak_hbm_bytes"), (int, float)):
+                out["manifest_peak_hbm_bytes"] = rec["peak_hbm_bytes"]
+        elif kind == "compile":
+            if rec.get("event") == "recompile":
+                out["recompiles"].append(
+                    {k: rec.get(k) for k in ("step", "cache_size",
+                                             "recompile_count",
+                                             "compile_events")})
+                if isinstance(rec.get("recompile_count"), (int, float)):
+                    out["recompile_count"] = max(
+                        out["recompile_count"], int(rec["recompile_count"]))
+            else:
+                out["shapes"].append(
+                    {k: rec.get(k) for k in _COMPILE_ROW_FIELDS})
+        elif kind == "event" and rec.get("rule") == "recompile_storm":
+            out["storm_events"] += 1
+    peaks = [s["peak_hbm_bytes"] for s in out["shapes"]
+             if isinstance(s.get("peak_hbm_bytes"), (int, float))]
+    if peaks:
+        out["peak_hbm_bytes"] = max(peaks)
+    for src, dst in (("lower_s", "total_lower_s"),
+                     ("compile_s", "total_compile_s")):
+        vals = [s[src] for s in out["shapes"]
+                if isinstance(s.get(src), (int, float))]
+        if vals:
+            out[dst] = round(sum(vals), 4)
+    return out
+
+
+def format_compile(name: str, summary: dict) -> str:
+    chunks = [f"compile: {name}"]
+    shapes = summary["shapes"]
+    if shapes:
+        rows = []
+        for s in shapes:
+            key = str(s.get("shape_key") or "-")
+            if len(key) > 40:
+                key = key[:37] + "..."
+            rows.append([
+                "-" if s.get("shape_index") is None
+                else str(s["shape_index"]),
+                "-" if s.get("step") is None else _fmt(s["step"]),
+                _fmt(s.get("flops")), _fmt(s.get("bytes_accessed")),
+                _fmt(s.get("peak_hbm_bytes")), _fmt(s.get("temp_bytes")),
+                _fmt(s.get("lower_s")), _fmt(s.get("compile_s")), key])
+        chunks.append(f"\n[shapes] ({len(shapes)} distinct dispatch "
+                      "shape(s))")
+        chunks.append(_table(rows, ["idx", "step", "flops", "bytes_acc",
+                                    "peak_hbm", "temp_bytes", "lower_s",
+                                    "compile_s", "shape_key"]))
+    else:
+        chunks.append("no compile records (run without --obs-mem, or a "
+                      "pre-memwatch run)")
+    recompiles = summary["recompiles"]
+    if recompiles:
+        rows = [["-" if r.get("step") is None else _fmt(r["step"]),
+                 _fmt(r.get("cache_size")), _fmt(r.get("recompile_count")),
+                 _fmt(r.get("compile_events"))] for r in recompiles]
+        chunks.append(f"\n[recompiles] ({len(recompiles)} cache-growth "
+                      "event(s))")
+        chunks.append(_table(rows, ["step", "cache_size",
+                                    "recompile_count", "compile_events"]))
+    tail = [f"recompile_count={summary['recompile_count']}"]
+    if summary["storm_events"]:
+        tail.append(f"recompile_storm events={summary['storm_events']}")
+    if summary["peak_hbm_bytes"] is not None:
+        tail.append(f"peak_hbm_bytes={_fmt(summary['peak_hbm_bytes'])}")
+    if summary["manifest_peak_hbm_bytes"] is not None:
+        tail.append("manifest peak_hbm_bytes="
+                    f"{_fmt(summary['manifest_peak_hbm_bytes'])}")
+    if summary["total_compile_s"] is not None:
+        tail.append(f"total compile_s={_fmt(summary['total_compile_s'])}")
+    chunks.append("\n" + "  ".join(tail))
+    return "\n".join(chunks)
+
+
+def run_compile(run: str, json_out: Optional[str] = None) -> int:
+    """``compile`` subcommand: the per-shape AOT compile log and the
+    recompile-watch events of one run."""
+    try:
+        records, bad = load_records(run)
+    except OSError as e:
+        print(f"cannot read {run}: {e}")
+        return 2
+    if bad:
+        print(f"note: {run}: skipped {bad} malformed line(s)")
+    summary = summarize_compile(records)
+    name = os.path.basename(os.path.normpath(run)) or run
+    print(format_compile(name, summary))
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump(summary, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0
+
+
+def summarize_mem(records: Iterable[dict]) -> dict:
+    """Memory-plane view over one run's records: the sampled "mem"
+    window stream (live-array footprint, device memory_stats where the
+    backend reports them) plus the three mem-plane anomaly rules."""
+    out = {
+        "samples": 0,
+        "first_step": None, "last_step": None,
+        "live_bytes_first": None, "live_bytes_last": None,
+        "live_bytes_max": None, "live_count_last": None,
+        "by_dtype": {},        # last sample's live bytes per dtype
+        "bytes_in_use_last": None, "peak_bytes_in_use": None,
+        "bytes_limit": None, "headroom_frac_max": None,
+        "devices_reporting": None,
+        "recompile_count": 0,
+        "rules": {},           # mem-plane rule -> firings
+    }
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "event" and rec.get("rule") in _MEM_RULES:
+            rule = str(rec["rule"])
+            out["rules"][rule] = out["rules"].get(rule, 0) + 1
+            continue
+        if kind != "mem":
+            continue
+        out["samples"] += 1
+        step = rec.get("step")
+        if isinstance(step, (int, float)):
+            if out["first_step"] is None:
+                out["first_step"] = step
+            out["last_step"] = step
+        lb = rec.get("live_bytes")
+        if isinstance(lb, (int, float)):
+            if out["live_bytes_first"] is None:
+                out["live_bytes_first"] = lb
+            out["live_bytes_last"] = lb
+            out["live_bytes_max"] = (lb if out["live_bytes_max"] is None
+                                     else max(out["live_bytes_max"], lb))
+        if isinstance(rec.get("live_count"), (int, float)):
+            out["live_count_last"] = rec["live_count"]
+        out["by_dtype"] = {
+            k[len("live_bytes_"):]: v for k, v in rec.items()
+            if k.startswith("live_bytes_") and isinstance(v, (int, float))
+        } or out["by_dtype"]
+        if isinstance(rec.get("bytes_in_use"), (int, float)):
+            out["bytes_in_use_last"] = rec["bytes_in_use"]
+        if isinstance(rec.get("bytes_limit"), (int, float)):
+            out["bytes_limit"] = rec["bytes_limit"]
+        if isinstance(rec.get("peak_bytes_in_use"), (int, float)):
+            out["peak_bytes_in_use"] = max(
+                out["peak_bytes_in_use"] or 0, rec["peak_bytes_in_use"])
+        if isinstance(rec.get("headroom_frac"), (int, float)):
+            out["headroom_frac_max"] = max(
+                out["headroom_frac_max"] or 0.0, rec["headroom_frac"])
+        if isinstance(rec.get("devices_reporting"), (int, float)):
+            out["devices_reporting"] = rec["devices_reporting"]
+        if isinstance(rec.get("recompile_count"), (int, float)):
+            out["recompile_count"] = max(out["recompile_count"],
+                                         int(rec["recompile_count"]))
+    return out
+
+
+def format_mem(name: str, summary: dict, compile_summary: dict) -> str:
+    chunks = [f"mem: {name}"]
+    n = summary["samples"]
+    if n:
+        grew = None
+        if (summary["live_bytes_first"] is not None
+                and summary["live_bytes_last"] is not None):
+            grew = summary["live_bytes_last"] - summary["live_bytes_first"]
+        chunks.append(
+            f"live arrays: {n} sample(s) over steps "
+            f"[{_fmt(summary['first_step'])}, {_fmt(summary['last_step'])}]"
+            f"  bytes {_fmt(summary['live_bytes_first'])} -> "
+            f"{_fmt(summary['live_bytes_last'])}"
+            + ("" if grew is None else f" (delta {_fmt(grew)})")
+            + ("" if summary["live_count_last"] is None
+               else f"  count={_fmt(summary['live_count_last'])}"))
+        if summary["by_dtype"]:
+            rows = [[dtype, _fmt(b)] for dtype, b in
+                    sorted(summary["by_dtype"].items(),
+                           key=lambda kv: -kv[1])]
+            chunks.append("\n[footprint by dtype] (last sample)")
+            chunks.append(_table(rows, ["dtype", "live_bytes"]))
+        if summary["bytes_in_use_last"] is not None:
+            chunks.append(
+                f"\ndevice: bytes_in_use={_fmt(summary['bytes_in_use_last'])}"
+                f" peak={_fmt(summary['peak_bytes_in_use'])}"
+                f" limit={_fmt(summary['bytes_limit'])}"
+                f" headroom_frac_max={_fmt(summary['headroom_frac_max'])}"
+                f" over {_fmt(summary['devices_reporting'])} device(s)")
+        else:
+            chunks.append("\ndevice: no memory_stats (backend does not "
+                          "report them; live_arrays-only view)")
+    else:
+        chunks.append("no mem records (run without --obs-mem, or a "
+                      "pre-memwatch run)")
+    shapes = compile_summary["shapes"]
+    if shapes:
+        rows = []
+        for s in shapes:
+            rows.append(["-" if s.get("shape_index") is None
+                         else str(s["shape_index"]),
+                         "-" if s.get("step") is None else _fmt(s["step"]),
+                         _fmt(s.get("peak_hbm_bytes")),
+                         _fmt(s.get("temp_bytes")),
+                         _fmt(s.get("argument_bytes")),
+                         _fmt(s.get("output_bytes")),
+                         _fmt(s.get("compile_s"))])
+        chunks.append(f"\n[compile] ({len(shapes)} dispatch shape(s), "
+                      f"recompile_count="
+                      f"{compile_summary['recompile_count']})")
+        chunks.append(_table(rows, ["idx", "step", "peak_hbm",
+                                    "temp_bytes", "arg_bytes", "out_bytes",
+                                    "compile_s"]))
+    rules = summary["rules"]
+    if rules:
+        chunks.append("\nmem-plane anomalies: " + "  ".join(
+            f"{rule}={cnt}" for rule, cnt in sorted(rules.items())))
+    elif n or shapes:
+        chunks.append("\nmem-plane anomalies: none "
+                      f"({', '.join(_MEM_RULES)} all quiet)")
+    return "\n".join(chunks)
+
+
+def run_mem(run: str, json_out: Optional[str] = None) -> int:
+    """``mem`` subcommand: one run's live-memory footprint (sampled
+    "mem" windows + per-dtype breakdown), its per-shape compile log, and
+    the leak/headroom/storm rule summary."""
+    try:
+        records, bad = load_records(run)
+    except OSError as e:
+        print(f"cannot read {run}: {e}")
+        return 2
+    if bad:
+        print(f"note: {run}: skipped {bad} malformed line(s)")
+    summary = summarize_mem(records)
+    comp = summarize_compile(records)
+    name = os.path.basename(os.path.normpath(run)) or run
+    print(format_mem(name, summary, comp))
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump({"mem": summary, "compile": comp}, fh, indent=1,
+                      sort_keys=True)
             fh.write("\n")
         print(f"wrote {json_out}")
     return 0
@@ -1213,6 +1506,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.add_argument("--json", dest="json_out", default=None)
         a = ap.parse_args(argv[1:])
         return run_plan(a.run, json_out=a.json_out)
+    if argv and argv[0] == "compile":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report compile",
+            description="Print a run's per-shape AOT compile log "
+                        "(flops, bytes accessed, peak-HBM estimate, "
+                        "wall times) and the recompile-watch events "
+                        "(obs/memwatch.py).")
+        ap.add_argument("run", help="run dir or record file")
+        ap.add_argument("--json", dest="json_out", default=None)
+        a = ap.parse_args(argv[1:])
+        return run_compile(a.run, json_out=a.json_out)
+    if argv and argv[0] == "mem":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report mem",
+            description="Print a run's live-memory footprint (sampled "
+                        "mem windows, per-dtype breakdown, device "
+                        "memory_stats), per-shape compile log, and the "
+                        "leak/headroom/storm anomaly summary.")
+        ap.add_argument("run", help="run dir or record file")
+        ap.add_argument("--json", dest="json_out", default=None)
+        a = ap.parse_args(argv[1:])
+        return run_mem(a.run, json_out=a.json_out)
     if argv and argv[0] == "ledger":
         ap = argparse.ArgumentParser(
             "gtopkssgd_tpu.obs.report ledger",

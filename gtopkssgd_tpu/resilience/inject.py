@@ -39,6 +39,14 @@ Kinds:
                       right before the next restore() — exercises
                       integrity verification and the fallback to the
                       previous step.
+  reshape@K           halve the per-shard batch axis of step K's host
+                      batch before device transfer — a NEW dispatch
+                      shape, so the jitted step retraces and the
+                      executable cache grows (the deterministic input
+                      for obs/memwatch.py's recompile_storm rule).
+                      Point faults fire once; the next dispatch is back
+                      to the canonical shape. A range re-fires per step
+                      in the window (sustained storm).
 
 Every firing logs one fsync'd "inject" record (fault, step, detail), so
 ``report recovery`` can line injected faults up against the recovery
@@ -53,7 +61,8 @@ import signal
 import time
 from typing import Any, List, Optional, Tuple
 
-KINDS = ("nan_grad", "slow_rank", "loader_raise", "preempt", "corrupt_ckpt")
+KINDS = ("nan_grad", "slow_rank", "loader_raise", "preempt", "corrupt_ckpt",
+         "reshape")
 
 # WHEN == "latest" sentinel (corrupt_ckpt: fires at the next restore).
 LATEST = -1
@@ -222,6 +231,27 @@ class FaultInjector:
         leaves, treedef = jax.tree.flatten(state.params)
         leaves[0] = leaves[0] * float("nan")
         return state._replace(params=jax.tree.unflatten(treedef, leaves))
+
+    def reshape_batch(self, batch, prev: int, new: int, axis: int = 2):
+        """Pre-transfer: reshape. Halves the per-shard batch axis of the
+        assembled host batch dict (numpy leaves, [P, nsteps, B, ...] —
+        ``axis`` indexes B; the trainer passes 3 when steps_per_dispatch
+        stacks an extra axis). A changed dispatch shape forces the
+        jitted step to retrace — the deterministic recompile chaos
+        input. Loss stays a batch mean, so training arithmetic survives
+        the smaller step; a 1-sample batch cannot halve and the fault
+        downgrades to a no-op record."""
+        for f, at in self._active("reshape", prev, new):
+            dim = min(v.shape[axis] for v in batch.values())
+            if dim < 2:
+                self._record(f, at, batch_axis=axis, from_dim=dim,
+                             to_dim=dim)
+                continue
+            half = dim // 2
+            self._record(f, at, batch_axis=axis, from_dim=dim, to_dim=half)
+            cut = (slice(None),) * axis + (slice(0, half),)
+            batch = {k: v[cut] for k, v in batch.items()}
+        return batch
 
     def maybe_preempt(self, prev: int, new: int, guard=None) -> None:
         """Post-dispatch: preempt. Sends this process a REAL SIGTERM so

@@ -49,6 +49,7 @@ from gtopkssgd_tpu.optimizer import (
 from gtopkssgd_tpu.obs import (
     AnomalyMonitor,
     StallWatchdog,
+    Thresholds,
     TimelineRecorder,
     Tracer,
     layer_names,
@@ -275,6 +276,36 @@ class TrainConfig:
                                    # fit provenance in manifest + plan
                                    # record. Malformed file fails at
                                    # startup. None = default lookup
+    obs_mem: bool = False          # compile/memory-plane watch
+                                   # (obs/memwatch.py): AOT compile
+                                   # accounting — one fsync'd "compile"
+                                   # record per distinct dispatch shape
+                                   # (cost/memory analysis, lower/
+                                   # compile wall times) with the
+                                   # peak-HBM estimate stamped into the
+                                   # manifest — plus the jit-cache
+                                   # recompile watch (recompile_storm
+                                   # rule) and sampled live-memory
+                                   # "mem" records feeding the
+                                   # device_mem_leak / hbm_headroom
+                                   # rules. Costs one AOT compile per
+                                   # distinct dispatch shape
+    obs_mem_interval: int = 50     # steps between live-memory samples
+                                   # (jax.live_arrays + memory_stats
+                                   # reads are host-side but not free);
+                                   # samples land at sync points the
+                                   # loop already pays
+    obs_recompile_warmup: int = 1  # compile-watch polls before the
+                                   # recompile_storm rule arms; 0 means
+                                   # ANY executable-cache growth fires
+                                   # (obs.events.Thresholds)
+    obs_mem_leak_windows: int = 3  # consecutive growing live-bytes
+                                   # windows before device_mem_leak
+                                   # fires (a plateau resets the streak)
+    obs_hbm_headroom_frac: float = 0.92  # bytes_in_use / bytes_limit
+                                   # fraction above which hbm_headroom
+                                   # fires (backends without
+                                   # memory_stats never arm it)
 
     # --- per-dataset defaults (the reference hardcoded these in DLTrainer) --
     def resolved(self) -> "TrainConfig":
@@ -388,6 +419,10 @@ class Trainer:
                 rho=(cfg.density
                      if cfg.compression not in DENSE_MODES else None),
                 halt_on=cfg.obs_halt_on,
+                thresholds=Thresholds(
+                    recompile_warmup=cfg.obs_recompile_warmup,
+                    mem_leak_windows=cfg.obs_mem_leak_windows,
+                    hbm_headroom_frac=cfg.obs_hbm_headroom_frac),
                 timeline=self.timeline,
             )
             if cfg.obs_events else None
@@ -548,6 +583,26 @@ class Trainer:
                           "comm_fit_beta_gbps": d.inputs.get("beta_gbps")}
         if self._bucket_plan is not None:
             plan_extra.update(self._bucket_plan.to_manifest())
+        # Compile-plane accounting (obs/memwatch.py, --obs-mem): build
+        # the jitted step and AOT lower/compile it at the canonical
+        # dispatch shape BEFORE the manifest is assembled, so the
+        # compile record's peak-HBM estimate rides the manifest header
+        # (run_manifest's **extra). The AOT pass never executes —
+        # abstract ShapeDtypeStruct batch leaves stand in for data, so
+        # no batch is consumed from the stream.
+        self._train_step = self._build_train_step()
+        self.memwatch = None
+        init_compile = None
+        if cfg.obs_mem:
+            from gtopkssgd_tpu.obs.memwatch import MemWatch
+            self.memwatch = MemWatch(
+                metrics=self.metrics, monitor=self.monitor,
+                mem_interval=cfg.obs_mem_interval, logger=self.logger)
+            init_compile = self.memwatch.account(
+                self._train_step, self.state, self.carry,
+                self._abstract_batch(), step=0, log=False)
+            if self.memwatch.peak_hbm_bytes is not None:
+                plan_extra["peak_hbm_bytes"] = self.memwatch.peak_hbm_bytes
         # Run-manifest header: first record of every metrics file, so
         # each is self-describing (config hash + resolved headline flags,
         # mesh, jax/backend versions, git sha). In sharded multi-process
@@ -557,6 +612,13 @@ class Trainer:
             cfg, mesh=self.mesh, num_params=self.num_params,
             steps_per_epoch=self.steps_per_epoch, **plan_extra)
         self.metrics.log("manifest", flush=True, **self._manifest)
+        # The manifest stays the FIRST record; the deferred startup
+        # compile record lands right after it, and the recompile watch
+        # arms on the same jitted callable the loop dispatches.
+        if init_compile is not None:
+            self.memwatch.log_compile(init_compile)
+        if self.memwatch is not None:
+            self.memwatch.attach(self._train_step)
         if self._plan_decision is not None:
             self.metrics.log("plan", flush=True,
                              **self._plan_decision.record())
@@ -582,7 +644,6 @@ class Trainer:
                 baseline={key: inputs.get(key) for key in
                           ("alpha_ms", "beta_gbps", "fit_source")},
                 metrics=self.metrics, monitor=self.monitor)
-        self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
         # Degrade fallback (recover-policy "degrade"): the sparse step
         # stays canonical; a dense-allreduce variant over the SAME
@@ -798,6 +859,8 @@ class Trainer:
                     self.logger.info("registry += %s", path)
             except (OSError, ValueError) as e:
                 self.logger.warning("registry append failed: %s", e)
+        if getattr(self, "memwatch", None) is not None:
+            self.memwatch.close()
         # The metrics file outlives close() (restore() can resume a closed
         # Trainer's training); only leaving the context ends the run.
         self.metrics.close()
@@ -930,7 +993,25 @@ class Trainer:
             )
         else:
             carry = ()
-        return state, carry
+        # Commit every leaf to its steady-state mesh placement. Freshly
+        # built jnp arrays are UNCOMMITTED (SingleDeviceSharding), so
+        # dispatch 1 would trace against UnspecifiedValue shardings while
+        # its outputs come back committed-replicated — and dispatch 2
+        # would then retrace and recompile the whole step: a full extra
+        # XLA compile at startup and a permanent second cache entry the
+        # recompile watch (obs/memwatch.py) flags. The residual is
+        # already committed P('dp') by expand_residual_per_device and
+        # passes through untouched.
+        from jax.sharding import NamedSharding
+
+        rep = NamedSharding(self.mesh, P())
+
+        def commit(leaf):
+            if getattr(leaf, "committed", False):
+                return leaf
+            return jax.device_put(leaf, rep)
+
+        return jax.tree.map(commit, state), jax.tree.map(commit, carry)
 
     def _input_key(self) -> str:
         return {
@@ -942,6 +1023,29 @@ class Trainer:
         it = iter(self.train_shards[0])
         b = next(it)
         return {k: v[None] for k, v in b.items()}
+
+    def _abstract_batch(self):
+        """ShapeDtypeStruct pytree of the canonical global dispatch
+        batch ([P, (spd,) nsteps_update, B, ...] — the exact leaves
+        _stack_shard_batches assembles), for the AOT compile-accounting
+        pass: lowering against it consumes no data and executes
+        nothing. Carries the dispatch's real P('dp') sharding so the
+        accounted executable is bit-for-bit the one the first dispatch
+        runs — which also lets that dispatch hit the persistent
+        compilation cache the AOT pass just warmed."""
+        from jax.sharding import NamedSharding
+
+        cfg = self.cfg
+        lead = ((self.p, cfg.steps_per_dispatch, cfg.nsteps_update)
+                if cfg.steps_per_dispatch > 1
+                else (self.p, cfg.nsteps_update))
+        dp = NamedSharding(self.mesh, P("dp"))
+        return {
+            k: jax.ShapeDtypeStruct(
+                lead + tuple(np.asarray(v[0]).shape),
+                np.asarray(v[0]).dtype, sharding=dp)
+            for k, v in self._peek_batch().items()
+        }
 
     # ------------------------------------------------------------ loss fns
     def _loss_fn(self, params, batch_stats, carry, batch, rng, train: bool):
@@ -1296,6 +1400,13 @@ class Trainer:
                             k: np.stack([h[k] for h in hosts], axis=1)
                             for k in hosts[0]
                         }
+                    if inj is not None:
+                        # reshape fault: a deliberately different
+                        # dispatch shape (B axis sits after the shard —
+                        # and with spd > 1 the scan — dim).
+                        host = inj.reshape_batch(
+                            host, step, step + spd,
+                            axis=2 if spd == 1 else 3)
                     batch = self._device_batch(host)
                 if rec is not None:
                     # Pre-step snapshot: what a `skip` action restores.
@@ -1422,6 +1533,18 @@ class Trainer:
                         rec.note_ok()
                 if wd is not None and synced:
                     wd.heartbeat(step=step)
+                if self.memwatch is not None and synced:
+                    # Compile/memory watch at a sync the loop already
+                    # paid: accounts a never-seen dispatch shape (one
+                    # fsync'd "compile" record), logs executable-cache
+                    # growth, samples live memory every
+                    # obs_mem_interval steps. May raise AnomalyHalt
+                    # (recompile_storm / device_mem_leak /
+                    # hbm_headroom) — records are durably written
+                    # first.
+                    self.memwatch.poll(
+                        step, fn=self._train_step,
+                        args=(self.state, self.carry, batch))
             # true_sync, not block_until_ready: the tunneled TPU platform
             # acks readiness before execution completes (utils/timers.py).
             from gtopkssgd_tpu.utils import true_sync

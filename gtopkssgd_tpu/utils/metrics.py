@@ -74,6 +74,13 @@ KINDS = frozenset({
     "regress",     # cross-run regression evidence row (gate smoke):
                    # registry regress exit codes + fitted-vs-true check
                    # against obs/registry.py's runs.jsonl baseline
+    "compile",     # compile-plane accounting (obs/memwatch.py): one
+                   # record per distinct dispatch shape (cost/memory
+                   # analysis + lower/compile wall times) and one per
+                   # executable-cache growth (recompile), fsync'd
+    "mem",         # sampled live-memory window (obs/memwatch.py):
+                   # live_arrays count/bytes by dtype + per-device
+                   # memory_stats where the backend exposes them
 })
 
 _SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")
