@@ -241,6 +241,53 @@ def bucket_rows(p: int = 32) -> list:
     return rows
 
 
+PIPELINE_WORKERS = (8, 32)
+PIPELINE_BS = tuple(range(1, 9))
+
+
+def pipeline_rows() -> list:
+    """Overlapped-pipeline evidence rows (parallel.bucketing): modeled
+    serial-vs-overlapped wall-clock span per (model, alpha, P, B). Each
+    order gets its own DP boundaries (serial pricing sums merge cost,
+    overlap prices the per-stage max(T_select, T_merge)), then the TRUE
+    span formula — sum(sel+merge) serial; fill + sum of interior maxes +
+    drain overlapped — so the row is the honest A/B 'auto' compares. The
+    sweep shows where pipelining pays: at alpha=0.1 ms (ICI-class) the
+    overlapped span dips below serial B=1 from small B on, while at the
+    measured-DCN alpha=22 ms the per-bucket latency term dwarfs anything
+    selection can hide and serial B=1 stays cheapest."""
+    from gtopkssgd_tpu.parallel import bucketing, plan_buckets
+    from gtopkssgd_tpu.parallel.planner import planner_inputs
+
+    beta = planner_inputs()["beta_gbps"]
+    rows = []
+    for dnn in BUCKET_MODELS:
+        sizes = _model_leaf_sizes(dnn)
+        for alpha in BUCKET_ALPHAS_MS:
+            for p in PIPELINE_WORKERS:
+                kw = dict(p=p, codec="fp32", alpha_ms=alpha,
+                          beta_gbps=beta)
+                for b in PIPELINE_BS:
+
+                    def _span(pipe):
+                        plan = plan_buckets(sizes, BUCKET_DENSITY,
+                                            buckets=b, pipeline=pipe,
+                                            **kw)
+                        return bucketing.pipeline_span_ms(plan, **kw)
+
+                    ser, ovl = _span("serial"), _span("overlap")
+                    rows.append({
+                        "model": dnn, "density": BUCKET_DENSITY,
+                        "p": p, "alpha_ms": alpha, "beta_gbps": beta,
+                        "n_buckets": b,
+                        "serial_span_ms": round(ser, 4),
+                        "overlap_span_ms": round(ovl, 4),
+                        "overlap_speedup": round(ser / max(ovl, 1e-9),
+                                                 4),
+                    })
+    return rows
+
+
 def main():
     from gtopkssgd_tpu.utils import enable_compilation_cache
 
@@ -288,6 +335,9 @@ def main():
         # Bucketing evidence rows: per-leaf vs DP-bucketed modeled comm
         # ms across the alpha sweep — also model-side, full grid always.
         "bucket_rows": bucket_rows(),
+        # Pipeline evidence rows: serial-vs-overlapped modeled span per
+        # (model, alpha, P, B) — model-side, full grid always.
+        "pipeline_rows": pipeline_rows(),
     }
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

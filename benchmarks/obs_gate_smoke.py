@@ -421,6 +421,130 @@ def run_bucket_smoke(out_dir: str) -> dict:
     return rec
 
 
+def run_overlap_smoke(out_dir: str) -> dict:
+    """Pipelined-vs-serial A/B (the overlapped-pipeline tentpole's
+    consumer): for each codec in {fp32, int8:64}, two tiny bucketed
+    gtopk_layerwise sub-runs (p=2, 2 steps, --buckets 4) differing ONLY
+    in --pipeline — 'serial' (the paper's barrier-pinned sequential
+    chain) vs 'overlap' (double-buffered stages). Returns the fields
+    the main run logs as ONE "overlap" record so the drift gate pins
+    the PR's acceptance numbers:
+
+      bit_delta_fp32 / bit_delta_int8   max |serial - overlap| over
+                                 EVERY param, error-feedback residual,
+                                 and telemetry leaf after 2 steps.
+                                 optimization_barrier is the identity,
+                                 so these are EXACTLY 0.0 — any epsilon
+                                 means the overlap reordered arithmetic
+      audit_recall_overlap       worst audited recall across the two
+                                 overlapped arms, floor 0.95
+      overlap_frac               measured (not modeled) hidden-comm
+                                 fraction: a profiler capture of the
+                                 overlapped fp32 arm through
+                                 obs.trace_attr.attribute — the 2-way
+                                 CPU mesh runs its lanes on separate
+                                 threads, so real cross-lane
+                                 concurrency shows up even here
+      overlap_frac_positive      1.0 iff overlap_frac > 0 (the
+                                 "overlap is real, not modeled-only"
+                                 acceptance pin)
+      crossover_n_buckets        model-side DP pin at the ResNet-50
+                                 crossover (alpha=0.1 ms, P=8, committed
+                                 beta): overlap pricing must choose
+                                 B > 1 where serial pricing collapses
+                                 to B=1, and 'auto' must pick overlap
+
+    The bit-identity comparison is the strongest structural pin in the
+    file: both arms share seed, data order, and boundaries, so every
+    leaf of (params, opt_state) — residuals and counters included —
+    must agree bit-for-bit."""
+    import jax
+    import numpy as np
+
+    from gtopkssgd_tpu.obs import report
+    from gtopkssgd_tpu.obs.trace_attr import attribute, capture
+    from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+    def _arm(codec: str, pipe: str):
+        sub = os.path.join(
+            out_dir, f"overlap_ab_{codec.split(':')[0]}_{pipe}")
+        cfg = TrainConfig(
+            dnn="resnet20", batch_size=4, nworkers=2,
+            compression="gtopk_layerwise", density=0.01, seed=42,
+            max_epochs=1, log_interval=2, eval_batches=1,
+            obs_interval=1, obs_audit_interval=2,
+            wire_codec=codec, buckets="4", pipeline=pipe, out_dir=sub)
+        frac = None
+        with Trainer(cfg) as t:
+            t.train(2)
+            leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(
+                (t.state.params, t.state.opt_state))]
+            if codec == "fp32" and pipe == "overlap":
+                # The measured-overlap evidence: capture the pipelined
+                # dispatch and attribute it — op-event interval unions
+                # across the two device lanes.
+                trace_dir = os.path.join(sub, "trace")
+                with capture(trace_dir):
+                    t.train(2)
+                frac = attribute(
+                    trace_dir, mode=cfg.compression).get("overlap_frac")
+        recs, _ = report.load_records(sub)
+        audited = [float(r["audit_recall"]) for r in recs
+                   if r.get("kind") == "obs"
+                   and float(r.get("audit_recall", -1.0)) >= 0.0]
+        recall = max(audited) if audited else -1.0
+        return leaves, recall, frac
+
+    deltas, recalls, frac = {}, [], None
+    for codec in ("fp32", "int8:64"):
+        s_leaves, _, _ = _arm(codec, "serial")
+        o_leaves, recall, f = _arm(codec, "overlap")
+        if f is not None:
+            frac = f
+        recalls.append(recall)
+        deltas[codec] = max(
+            float(np.max(np.abs(a.astype(np.float64)
+                                - b.astype(np.float64))))
+            if a.size else 0.0
+            for a, b in zip(s_leaves, o_leaves))
+    # Model-side crossover pin: at ICI-class alpha the overlap-priced
+    # DP must open up B > 1 on real ResNet-50 leaf sizes while serial
+    # pricing keeps the single merge, and 'auto' must take the
+    # overlapped order (all deterministic — pure cost model).
+    from benchmarks.merge_bench import _model_leaf_sizes
+    from gtopkssgd_tpu.parallel import plan_buckets
+    from gtopkssgd_tpu.parallel.planner import planner_inputs
+
+    sizes = _model_leaf_sizes("resnet50")
+    kw = dict(p=8, codec="fp32", alpha_ms=0.1,
+              beta_gbps=planner_inputs()["beta_gbps"])
+    cross = plan_buckets(sizes, 0.001, buckets="auto",
+                         pipeline="overlap", **kw)
+    cross_serial = plan_buckets(sizes, 0.001, buckets="auto",
+                                pipeline="serial", **kw)
+    cross_auto = plan_buckets(sizes, 0.001, buckets="auto",
+                              pipeline="auto", **kw)
+    recall_min = min(recalls)
+    return {
+        "pipeline": "overlap",
+        "n_buckets": 4.0,
+        "bit_delta_fp32": deltas["fp32"],
+        "bit_delta_int8": deltas["int8:64"],
+        "bit_identity_ok": float(deltas["fp32"] == 0.0
+                                 and deltas["int8:64"] == 0.0),
+        "audit_recall_overlap": recall_min,
+        "recall_floor_breach": round(max(0.0, 0.95 - recall_min), 6),
+        "overlap_frac": (round(float(frac), 6)
+                         if frac is not None else -1.0),
+        "overlap_frac_positive": float(frac is not None and frac > 0),
+        "crossover_n_buckets": float(cross.n_buckets),
+        "crossover_b_gt1": float(cross.n_buckets > 1),
+        "crossover_serial_b1": float(cross_serial.n_buckets == 1),
+        "crossover_auto_overlap": float(
+            cross_auto.pipeline == "overlap"),
+    }
+
+
 def run_calib_smoke(out_dir: str) -> dict:
     """Self-calibrating comm-model smoke (the ISSUE-13 tentpole's
     consumer): drives obs/calib.py and obs/registry.py against SYNTHETIC
@@ -658,6 +782,7 @@ def run_smoke(out_dir: str) -> str:
     codec_rec = run_codec_smoke(out_dir)
     plan_rec = run_plan_smoke(out_dir, codec_rec)
     bucket_rec = run_bucket_smoke(out_dir)
+    overlap_rec = run_overlap_smoke(out_dir)
     calib_rec = run_calib_smoke(out_dir)
     mem_rec = run_mem_smoke(out_dir)
 
@@ -711,6 +836,12 @@ def run_smoke(out_dir: str) -> str:
         # floor on the bucketed arm, and the bucket-summed ledger's
         # modeled-vs-measured bytes ratio.
         t.metrics.log("bucket", **bucket_rec)
+        # And the overlapped-pipeline A/B: exact-zero serial-vs-overlap
+        # bit-identity deltas (fp32 + int8), the measured overlap_frac
+        # from the pipelined arm's trace capture, the recall floor, and
+        # the model-side DP crossover pin (B>1 under overlap pricing at
+        # ResNet-50/alpha=0.1). Durable evidence -> flush=True.
+        t.metrics.log("overlap", flush=True, **overlap_rec)
         # And the calibration smoke: the robust fit pinned against its
         # synthetic ground truth, the exact refit/drift-firing counts,
         # the closed obs->planner artifact round-trip, and (as a

@@ -89,6 +89,33 @@ reference merged layer-by-layer with no cost model):
                                          them with modeled ms for
                                          B in {1, chosen, L}.
 
+    --pipeline SPEC                      bucketed layerwise only: bucket
+                                         execution order. Grammar:
+                                         serial (default — the paper's
+                                         sequential select->merge
+                                         chain, pinned with
+                                         optimization barriers) |
+                                         overlap (double-buffered
+                                         stages: bucket b+1's fused
+                                         selection runs concurrently
+                                         with bucket b's codec-framed
+                                         merge; bit-identical to
+                                         serial) | auto (cheaper
+                                         modeled pipeline span wins;
+                                         also switches --buckets auto
+                                         to overlap pricing, where the
+                                         DP objective is the per-stage
+                                         max(T_select, T_merge) — so
+                                         'auto auto' can pick a larger
+                                         B than serial pricing would).
+                                         The resolved order is stamped
+                                         into the manifest/'plan'/
+                                         'bucket' records and carried
+                                         by ``report history`` /
+                                         ``report regress``; 'report
+                                         attr' measures the realized
+                                         overlap_frac from the trace.
+
 Observability flags (obs subsystem — no reference equivalent; the
 reference's only telemetry was text logs):
 
@@ -298,6 +325,17 @@ def build_argparser() -> argparse.ArgumentParser:
                         "per bucket. Boundaries are stamped into the "
                         "manifest and logged as the 'bucket' record "
                         "(``report plan`` prints them)")
+    p.add_argument("--pipeline", default="serial",
+                   help="bucketed layerwise only: bucket execution "
+                        "order. 'serial' (default) pins the paper's "
+                        "sequential select->merge chain; 'overlap' "
+                        "double-buffers the stages so bucket b+1's "
+                        "selection runs under bucket b's merge — "
+                        "bit-identical to serial; 'auto' picks the "
+                        "cheaper modeled span and prices --buckets "
+                        "auto with the overlap objective. Requires a "
+                        "bucketed wire (--buckets != concat) for "
+                        "'overlap'")
     p.add_argument("--clip-grad-norm", type=float, default=None)
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="optimizer steps per jitted dispatch (lax.scan "
@@ -512,6 +550,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         wire_codec=args.wire_codec,
         comm_plan=args.comm_plan,
         buckets=args.buckets,
+        pipeline=args.pipeline,
         clip_grad_norm=args.clip_grad_norm,
         nsteps_update=args.nsteps_update,
         steps_per_dispatch=args.steps_per_dispatch,

@@ -36,6 +36,19 @@ SPARSE_MODES = GTOPK_MODES + ALLGATHER_MODES + HIER_MODES + LAYERWISE_MODES
 # CommPlan is always fully specified.
 SCHEDULES = ("psum", "tree", "balanced", "allgather")
 
+# Pipeline vocabulary (the execution-order axis of the bucketed layerwise
+# wire, PR 15). A schedule fixes the wire algorithm of ONE merge; the
+# pipeline fixes how the B bucket merges interleave with the B bucket
+# selections inside a step: 'serial' is the paper's strictly sequential
+# T_select + T_comm (bucket b+1's selection waits on bucket b's merge —
+# the bit-identity oracle), 'overlap' cuts that dependence so bucket
+# b+1's selection is issued while bucket b's ppermute rounds are in
+# flight (Ok-Topk-style pipelining, arXiv:2201.07598). Both apply the
+# same values in the same order, so results are bit-identical; only the
+# exposed wall-clock differs. The user-facing spec grammar adds 'auto'
+# (bucketing.parse_pipeline), which resolves to one of these two.
+PIPELINES = ("serial", "overlap")
+
 
 def default_schedule(mode: str) -> str:
     """The hand-picked historical wire schedule for `mode` — what every

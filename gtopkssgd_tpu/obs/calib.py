@@ -196,6 +196,13 @@ class CommCalibrator:
         self.max_samples = max(self.fit_window, int(max_samples))
         # (msgs, wire_bytes, t_comm_ms) triples, oldest first.
         self.samples: List[Tuple[int, float, float]] = []
+        # Samples measured under an OVERLAPPED pipeline, kept apart:
+        # their t_comm is the exposed (partially hidden) span, so the
+        # per-message alpha-beta inversion does not hold for them —
+        # folding them in would bias the serial fit low. Tagged via
+        # observe(..., overlapped=True), counted in the calib record,
+        # never fitted.
+        self.overlap_samples: List[Tuple[int, float, float]] = []
         # First completed fit — the "startup fit" drift is reported
         # against (did the fabric change DURING the run?).
         self.startup_fit: Optional[Dict[str, Any]] = None
@@ -203,16 +210,28 @@ class CommCalibrator:
         self._pending = 0
 
     def observe(self, step: int, wire_bytes: float, t_comm_ms: float,
-                msgs: Optional[int] = None) -> Optional[Dict[str, Any]]:
+                msgs: Optional[int] = None,
+                overlapped: bool = False) -> Optional[Dict[str, Any]]:
         """Ingest one measured sample; returns the ``calib`` record when
         this sample completed a refit window, else None. ``msgs``
         overrides the per-merge message count (bucketed runs: B merges
-        per step multiply it). Raises AnomalyHalt through the monitor
-        when a refit's drift reaches the halt severity — after the calib
-        record is durably written."""
+        per step multiply it). ``overlapped`` tags a sample measured
+        under the overlapped bucket pipeline: its t_comm is the exposed
+        span with part of the wire time hidden under selection, so it
+        is retained separately (``overlap_samples``) and NEVER enters
+        the serial alpha-beta fit. Raises AnomalyHalt through the
+        monitor when a refit's drift reaches the halt severity — after
+        the calib record is durably written."""
         m = self.msgs if msgs is None else int(msgs)
         if (m <= 0 or not _finite(wire_bytes) or wire_bytes <= 0
                 or not _finite(t_comm_ms) or t_comm_ms <= 0):
+            return None
+        if overlapped:
+            self.overlap_samples.append(
+                (m, float(wire_bytes), float(t_comm_ms)))
+            if len(self.overlap_samples) > self.max_samples:
+                del self.overlap_samples[
+                    :len(self.overlap_samples) - self.max_samples]
             return None
         self.samples.append((m, float(wire_bytes), float(t_comm_ms)))
         if len(self.samples) > self.max_samples:
@@ -245,6 +264,10 @@ class CommCalibrator:
             "wire_mode": self.wire_mode,
             "p": self.p,
         }
+        if self.overlap_samples:
+            # Visible evidence the exclusion worked: how many tagged
+            # overlapped samples were kept OUT of this serial fit.
+            rec["n_overlap_excluded"] = len(self.overlap_samples)
         if self.baseline.get("fit_source") is not None:
             rec["planner_fit_source"] = self.baseline["fit_source"]
         da, db = _ratio_x(fit["alpha_ms"], base_a), _ratio_x(

@@ -48,7 +48,13 @@ _MANIFEST_KEYS = ("config_hash", "git_sha", "dnn", "dataset",
 # estimate that moves only when the program or its sharding does (10%
 # covers XLA temp-allocation jitter across compiler versions), and
 # recompile_count is exact — ANY cross-run change in how often the jit
-# cache grew under the same config is a regression.
+# cache grew under the same config is a regression. overlap_frac (the
+# measured fraction of comm hidden under compute/select, trace-derived)
+# gets a purely absolute 0.1 slack: it lives in [0, 1] and a serial
+# baseline of 0.0 must still bound an overlapped current run — a
+# pipelined run whose overlap silently collapsed back to serial is
+# exactly the regression this line exists to catch. n_buckets is exact:
+# the DP re-deciding B under the same config means the cost model moved.
 REGRESS_CHECKS: Tuple[Tuple[str, float, float], ...] = (
     ("steps_per_sec", 0.25, 0.0),
     ("loss_last", 0.25, 0.0),
@@ -59,7 +65,15 @@ REGRESS_CHECKS: Tuple[Tuple[str, float, float], ...] = (
     ("wire_bytes_per_step", 0.10, 0.0),
     ("peak_hbm_bytes", 0.10, 0.0),
     ("recompile_count", 0.0, 0.0),
+    ("overlap_frac", 0.0, 0.10),
+    ("n_buckets", 0.0, 0.0),
 )
+
+# String-valued stats checked for EXACT equality (the numeric loop's
+# finiteness gate would silently skip them — a chosen pipeline that
+# flips serial<->overlap under the same config is a plan regression,
+# not noise).
+REGRESS_EXACT_STR: Tuple[str, ...] = ("pipeline",)
 
 
 def _finite(x: Any) -> bool:
@@ -89,10 +103,12 @@ def run_summary(records: Sequence[Dict[str, Any]]
     manifest = None
     trains: List[Dict[str, Any]] = []
     last_calib = None
+    last_plan = None
     final_status = None
     recall_floor = None
     wire_sum, wire_n = 0.0, 0
     ratio_sum, ratio_n = 0.0, 0
+    ofrac_sum, ofrac_n = 0.0, 0
     saw_memwatch = False
     recompile_count = 0
     for rec in records:
@@ -103,6 +119,8 @@ def run_summary(records: Sequence[Dict[str, Any]]
             trains.append(rec)
         elif kind == "calib":
             last_calib = rec
+        elif kind == "plan":
+            last_plan = rec
         elif kind in ("compile", "mem"):
             # memwatch (--obs-mem) was on; recompile_count stays an
             # explicit 0 in that case so regress can pin it exactly.
@@ -126,6 +144,9 @@ def run_summary(records: Sequence[Dict[str, Any]]
             if _finite(tc) and _finite(tt) and tt > 0:
                 ratio_sum += float(tc) / float(tt)
                 ratio_n += 1
+            if _finite(rec.get("overlap_frac")):
+                ofrac_sum += float(rec["overlap_frac"])
+                ofrac_n += 1
         elif kind == "recovery" and rec.get("final_status") is not None:
             final_status = rec.get("final_status")
     if manifest is None:
@@ -162,6 +183,18 @@ def run_summary(records: Sequence[Dict[str, Any]]
         stats["peak_hbm_bytes"] = manifest["peak_hbm_bytes"]
     if saw_memwatch:
         stats["recompile_count"] = recompile_count
+    if ofrac_n:
+        stats["overlap_frac"] = round(ofrac_sum / ofrac_n, 6)
+    # Plan-shape stats: the chosen pipeline (plan record wins — it is
+    # the decision as executed; the manifest stamp is the fallback for
+    # runs without a planner) and the DP's bucket count, so regress can
+    # pin both exactly across runs of the same config.
+    pipeline = (last_plan or {}).get("pipeline") or manifest.get("pipeline")
+    if pipeline is not None:
+        stats["pipeline"] = str(pipeline)
+    bucket_ks = manifest.get("bucket_ks")
+    if isinstance(bucket_ks, (list, tuple)) and bucket_ks:
+        stats["n_buckets"] = len(bucket_ks)
     if final_status is not None:
         stats["final_status"] = final_status
     entry["stats"] = stats
@@ -231,6 +264,9 @@ def history_rows(entries: Sequence[Dict[str, Any]],
             _cell(stats.get("wire_bytes_per_step")),
             _cell(stats.get("peak_hbm_bytes")),
             _cell(stats.get("recompile_count")),
+            str(stats.get("pipeline", "-")),
+            _cell(stats.get("n_buckets")),
+            _cell(stats.get("overlap_frac")),
             str(stats.get("final_status", "-")),
         ])
     return rows
@@ -238,7 +274,8 @@ def history_rows(entries: Sequence[Dict[str, Any]],
 
 HISTORY_HEADER = ["config", "git", "steps", "steps/s", "loss",
                   "comm_ratio", "alpha_ms", "beta_gbps", "recall",
-                  "wireB/step", "peak_hbm", "recomp", "status"]
+                  "wireB/step", "peak_hbm", "recomp", "pipeline", "B",
+                  "ovl_frac", "status"]
 
 
 def pick_baseline(entry: Dict[str, Any],
@@ -291,6 +328,22 @@ def regress(entry: Dict[str, Any], baseline: Dict[str, Any]
                 failures += 1
         rows.append([field, _cell(base.get(field)), _cell(cur.get(field)),
                      tol_s, status])
+    for field in REGRESS_EXACT_STR:
+        b, c = base.get(field), cur.get(field)
+        if b is None and c is None:
+            continue
+        if b is None:
+            status = "new"
+        elif c is None:
+            status = "MISSING"
+            failures += 1
+        elif str(c) != str(b):
+            status = "FAIL"
+            failures += 1
+        else:
+            status = "ok"
+        rows.append([field, "-" if b is None else str(b),
+                     "-" if c is None else str(c), "exact", status])
     return rows, failures
 
 

@@ -1094,7 +1094,8 @@ def run_plan(run: str, json_out: Optional[str] = None) -> int:
             "auto-selected (cheapest modeled comm_ms; historical "
             "schedule wins ties)")
         print(f"plan: {rec.get('plan')} (schedule={rec.get('schedule')}"
-              f", wire_mode={rec.get('wire_mode')}) for mode="
+              f", wire_mode={rec.get('wire_mode')}, pipeline="
+              f"{rec.get('pipeline', 'serial')}) for mode="
               f"{rec.get('mode')} — {how}")
         print(f"inputs: p={rec.get('p')} n={rec.get('n')} k={rec.get('k')}"
               f" codec={rec.get('codec')} ici_size={rec.get('ici_size')}"
@@ -1103,32 +1104,56 @@ def run_plan(run: str, json_out: Optional[str] = None) -> int:
               f"ici_gbps={rec.get('ici_gbps')} "
               f"(fit: {rec.get('fit_source')})")
         rows = []
+        # Span columns appear once candidates carry them (post-pipeline
+        # planner); older records print the comm-only table unchanged.
+        have_spans = any(c.get("span_serial_ms") is not None
+                         for c in rec["candidates"])
         for c in rec["candidates"]:
             mark = "*" if c.get("name") == rec.get("plan") else ""
-            rows.append([f"{c.get('name')}{mark}",
-                         str(c.get('schedule')),
-                         _fmt(c.get('comm_ms')),
-                         _fmt(c.get('wire_bytes'))])
-        print(_table(rows, ["candidate", "schedule", "comm_ms",
-                            "wire_bytes/step"]))
+            row = [f"{c.get('name')}{mark}",
+                   str(c.get('schedule')),
+                   _fmt(c.get('comm_ms')),
+                   _fmt(c.get('wire_bytes'))]
+            if have_spans:
+                row += [_fmt(c.get('span_serial_ms')),
+                        _fmt(c.get('span_overlap_ms'))]
+            rows.append(row)
+        header = ["candidate", "schedule", "comm_ms", "wire_bytes/step"]
+        if have_spans:
+            header += ["span_serial_ms", "span_overlap_ms"]
+        print(_table(rows, header))
     # Bucket plan (parallel.bucketing): boundaries the run actually used
     # plus the modeled ms of the degenerate partitions, so the reader
     # sees where the chosen B sits on the alpha-beta curve.
     for rec in bucket_recs:
+        pipe = rec.get("pipeline")
         print(f"buckets: {rec.get('buckets')} -> B={rec.get('n_buckets')}"
               f" over L={rec.get('n_leaves')} leaves  "
-              f"(alpha_ms={rec.get('alpha_ms')} "
+              + (f"pipeline={pipe}  " if pipe else "")
+              + f"(alpha_ms={rec.get('alpha_ms')} "
               f"beta_gbps={rec.get('beta_gbps')})")
         print(f"modeled comm ms: B=1 {_fmt(rec.get('modeled_ms_b1'))}  "
               f"chosen {_fmt(rec.get('modeled_ms'))}  "
               f"B=L {_fmt(rec.get('modeled_ms_leaf'))}")
-        rows = [[str(r.get("bucket")), str(r.get("leaves")),
-                 str(r.get("n_leaves")), str(r.get("elems")),
-                 str(r.get("k")), _fmt(r.get("wire_bytes")),
-                 _fmt(r.get("modeled_ms"))]
-                for r in rec["rows"]]
-        print(_table(rows, ["bucket", "leaves", "n_leaves", "elems", "k",
-                            "wire_bytes", "modeled_ms"]))
+        # stage_ms rows exist on post-pipeline records: the per-bucket
+        # DP objective (merge under serial, max(select, merge) under
+        # overlap) next to the raw merge cost.
+        have_stage = any(r.get("stage_ms") is not None
+                         for r in rec["rows"])
+        rows = []
+        for r in rec["rows"]:
+            row = [str(r.get("bucket")), str(r.get("leaves")),
+                   str(r.get("n_leaves")), str(r.get("elems")),
+                   str(r.get("k")), _fmt(r.get("wire_bytes")),
+                   _fmt(r.get("modeled_ms"))]
+            if have_stage:
+                row += [_fmt(r.get("select_ms")), _fmt(r.get("stage_ms"))]
+            rows.append(row)
+        header = ["bucket", "leaves", "n_leaves", "elems", "k",
+                  "wire_bytes", "modeled_ms"]
+        if have_stage:
+            header += ["select_ms", "stage_ms"]
+        print(_table(rows, header))
     if json_out:
         with open(json_out, "w") as fh:
             json.dump({"decisions": decisions, "buckets": bucket_recs},

@@ -23,6 +23,23 @@ Two attribution sources, in preference order:
       ``args.hlo_op`` on the runtime's executor threads and annotations
       stay host-side.
 
+The spans-vs-ops choice is made PER CLASS, not globally: a partially
+annotated capture (say only the comm scopes propagated to the device
+lanes) keeps span truth for the classes the annotations cover and the
+op classifier for the rest (``source`` = "mixed"); before PR 15 one
+thin class silently dragged all three onto the op classifier.
+
+Overlap measurement (PR 15): the three per-class sums assume the terms
+are disjoint in time — exactly the assumption the overlapped bucket
+pipeline breaks. ``attribute`` therefore also reports ``overlap_frac``:
+the wall-clock interval union of comm events intersected with the union
+of non-comm (compute+select) events, as a fraction of the comm union —
+the fraction of communication time HIDDEN under other work. 0.0 on a
+strictly serial schedule; > 0 once the pipelined stage loop actually
+interleaves. Computed from raw (ts, dur) wall intervals across all
+device lanes (cross-lane concurrency is the point), from the op events
+when any exist, else from the annotated device spans.
+
 Durations are SELF times: a structural op (``while``, ``call``) nests its
 children on the same lane, so summing raw ``dur`` double-counts; each
 lane is resolved with an interval-nesting stack (sort by (ts, -end),
@@ -201,6 +218,48 @@ def self_durations_us(events: List[dict]) -> List[float]:
 
 # ------------------------------------------------------------ attribution
 
+def _interval_union(intervals: List[Tuple[float, float]]
+                    ) -> List[Tuple[float, float]]:
+    """Merge (start, end) intervals into a sorted disjoint union."""
+    merged: List[List[float]] = []
+    for s, e in sorted(intervals):
+        if e <= s:
+            continue
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    return [(s, e) for s, e in merged]
+
+
+def _intersection_us(a: List[Tuple[float, float]],
+                     b: List[Tuple[float, float]]) -> float:
+    """Total overlap length of two disjoint sorted interval unions."""
+    total, i, j = 0.0, 0, 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def overlap_fraction(comm_iv: List[Tuple[float, float]],
+                     other_iv: List[Tuple[float, float]]) -> float:
+    """Fraction of the comm wall-clock union hidden under non-comm
+    work: |union(comm) ∩ union(other)| / |union(comm)|. 0.0 when no
+    comm intervals exist."""
+    comm_u = _interval_union(comm_iv)
+    comm_len = sum(e - s for s, e in comm_u)
+    if comm_len <= 0:
+        return 0.0
+    return _intersection_us(comm_u, _interval_union(other_iv)) / comm_len
+
+
 def attribute(trace, mode: Optional[str] = None,
               min_span_coverage: float = 0.5) -> dict:
     """The paper's decomposition from a chrome trace.
@@ -208,10 +267,13 @@ def attribute(trace, mode: Optional[str] = None,
     ``trace`` is a capture dir, a trace file path, or an already-loaded
     chrome-trace dict. Returns a flat record (no 'kind' key — callers log
     it as kind="attr"): t_{compute,select,comm}_us self-time totals,
-    frac_* over their sum, the chosen ``source`` ("spans" when annotated
-    device events cover ≥ min_span_coverage of the op time, else "ops"),
-    op counts, and the top ops per bucket (strings; the report CLI prints
-    them, aggregation ignores them).
+    frac_* over their sum, the per-class span/ops choice (``source`` =
+    "spans" when every class with data uses annotated device spans
+    covering ≥ min_span_coverage of that class's op time, "ops" when
+    none does, "mixed" otherwise, with the per-class pick in
+    ``source_{term}``), the measured ``overlap_frac`` (see module
+    docstring), op counts, and the top ops per bucket (strings; the
+    report CLI prints them, aggregation ignores them).
     """
     trace_file = None
     if isinstance(trace, str):
@@ -231,6 +293,10 @@ def attribute(trace, mode: Optional[str] = None,
     op_us = {t: 0.0 for t in TERMS}
     op_top: Dict[str, Dict[str, float]] = {t: collections.defaultdict(float)
                                            for t in TERMS}
+    # Raw wall (start, end) intervals per bucket, across ALL lanes —
+    # the overlap measurement wants wall-clock concurrency (two lanes
+    # busy at once), which self times deliberately erase.
+    op_iv: Dict[str, List[Tuple[float, float]]] = {t: [] for t in TERMS}
     n_ops = 0
     for lane_events in lanes.values():
         selfs = self_durations_us(lane_events)
@@ -241,11 +307,20 @@ def attribute(trace, mode: Optional[str] = None,
             bucket = classify_op(name)
             op_us[bucket] += us
             op_top[bucket][name] += us
+            ts = float(e.get("ts", 0.0))
+            # Self time for the interval length: a structural op
+            # (while/call) must not blanket its children's window with
+            # its own class. Anchored at ts — the self fragments of a
+            # wrapper may sit later in its window, an approximation
+            # that only matters for the wrappers' bookkeeping slivers.
+            if us > 0:
+                op_iv[bucket].append((ts, ts + us))
             n_ops += 1
 
     # Annotation-named DEVICE events (TPU propagates TraceAnnotations to
     # device lanes; op events themselves are excluded above).
     span_us = {t: 0.0 for t in TERMS}
+    span_iv: Dict[str, List[Tuple[float, float]]] = {t: [] for t in TERMS}
     n_spans = 0
     for e in events:
         if (e.get("ph") != "X" or e.get("pid") not in dev_pids
@@ -257,27 +332,51 @@ def attribute(trace, mode: Optional[str] = None,
         bucket = classify_span(str(e.get("name", "")))
         if bucket is not None:
             span_us[bucket] += _event_us(e)
+            ts = float(e.get("ts", 0.0))
+            dur = float(e.get("dur", 0.0))
+            if dur > 0:
+                span_iv[bucket].append((ts, ts + dur))
             n_spans += 1
 
-    op_total = sum(op_us.values())
-    span_total = sum(span_us.values())
-    use_spans = (span_total > 0
-                 and span_total >= min_span_coverage * max(op_total, 1e-9))
-    chosen = span_us if use_spans else op_us
+    # Per-CLASS source selection: use a class's annotated spans when
+    # they exist and cover at least min_span_coverage of that class's
+    # op time (or the op classifier saw nothing for it); fall back to
+    # ops for the others. Classes with no data in EITHER source don't
+    # vote on the overall label.
+    use_spans_t = {
+        t: (span_us[t] > 0
+            and (op_us[t] == 0
+                 or span_us[t] >= min_span_coverage * op_us[t]))
+        for t in TERMS}
+    chosen = {t: span_us[t] if use_spans_t[t] else op_us[t] for t in TERMS}
     total = sum(chosen.values())
+    votes = [use_spans_t[t] for t in TERMS
+             if span_us[t] > 0 or op_us[t] > 0]
+    source = ("spans" if votes and all(votes)
+              else "ops" if not any(votes) else "mixed")
+
+    # Measured comm overlap: wall-interval union of the comm class vs
+    # the union of everything else, from the same per-class source the
+    # decomposition chose (ops when any exist — spans can blanket a
+    # whole step on partially-annotated captures).
+    iv = op_iv if n_ops > 0 else span_iv
+    ofrac = overlap_fraction(
+        iv["comm"], [x for t in TERMS if t != "comm" for x in iv[t]])
 
     rec = {
         "mode": mode,
-        "source": "spans" if use_spans else "ops",
+        "source": source,
         "n_op_events": n_ops,
         "n_span_events": n_spans,
         "t_total_us": round(total, 1),
+        "overlap_frac": round(ofrac, 6),
     }
     if trace_file is not None:
         rec["trace_file"] = trace_file
     for t in TERMS:
         rec[f"t_{t}_us"] = round(chosen[t], 1)
         rec[f"frac_{t}"] = round(chosen[t] / total, 6) if total else 0.0
+        rec[f"source_{t}"] = "spans" if use_spans_t[t] else "ops"
     for t in TERMS:
         rows = sorted(op_top[t].items(), key=lambda kv: -kv[1])[:3]
         rec[f"top_{t}_ops"] = ", ".join(
@@ -415,19 +514,23 @@ def capture(log_dir: str):
 
 def format_attr(rec: dict) -> str:
     """Render one attr record as the paper's decomposition table."""
-    header = ["term", "time_ms", "frac"]
+    header = ["term", "time_ms", "frac", "src"]
     rows = []
     for t in TERMS:
         us = float(rec.get(f"t_{t}_us", 0.0))
         rows.append([f"T_{t}", f"{us / 1e3:.3f}",
-                     f"{float(rec.get(f'frac_{t}', 0.0)):.4f}"])
-    widths = [max(len(r[i]) for r in [header] + rows) for i in range(3)]
+                     f"{float(rec.get(f'frac_{t}', 0.0)):.4f}",
+                     str(rec.get(f"source_{t}", rec.get("source", "?")))])
+    widths = [max(len(r[i]) for r in [header] + rows)
+              for i in range(len(header))]
     lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths))
              for r in [header, ["-" * w for w in widths]] + rows]
     head = (f"[attr] source={rec.get('source')}"
             + (f"  mode={rec['mode']}" if rec.get("mode") else "")
             + f"  total={float(rec.get('t_total_us', 0.0)) / 1e3:.3f}ms"
-            + f"  op_events={rec.get('n_op_events')}")
+            + f"  op_events={rec.get('n_op_events')}"
+            + (f"  overlap_frac={float(rec['overlap_frac']):.4f}"
+               if rec.get("overlap_frac") is not None else ""))
     tops = [f"  top {t}: {rec[f'top_{t}_ops']}"
             for t in TERMS if rec.get(f"top_{t}_ops")]
     return "\n".join([head] + lines + tops)

@@ -60,7 +60,7 @@ from gtopkssgd_tpu.ops import (
 from gtopkssgd_tpu.parallel import (
     get_codec, ici_dense_psum, parse_buckets, plan_buckets, resolve_plan,
     roundtrip_aligned, sparse_allreduce, validate_pin)
-from gtopkssgd_tpu.parallel.bucketing import buckets_key
+from gtopkssgd_tpu.parallel.bucketing import buckets_key, parse_pipeline
 
 Array = jax.Array
 ScalarOrSchedule = Union[float, Callable[[Array], Array]]
@@ -114,6 +114,7 @@ def gtopk_sgd(
     wire_codec: str = "fp32",
     comm_plan: Optional[str] = "auto",
     buckets: Union[str, int] = "concat",
+    pipeline: str = "serial",
     warmup_dense_steps: int = 0,
     momentum_correction: bool = False,
     telemetry: bool = False,
@@ -213,6 +214,25 @@ def gtopk_sgd(
     per-leaf selection AND per-leaf merges — the fully-layerwise end;
     ``auto`` at B=1 is bit-identical to the flat ``gtopk`` pipeline over
     the raveled model (same k: ceil(density * N)).
+
+    ``pipeline`` (bucketed layerwise only; parallel.bucketing grammar
+    ``serial | overlap | auto``) sets the EXECUTION ORDER of the B
+    select/merge stages within one step. ``serial`` is the paper's
+    strictly sequential T_select + T_comm: bucket b+1's selection is
+    gated (``lax.optimization_barrier``) on bucket b's merge outputs,
+    so exactly one stage runs at a time — the bit-identity oracle and
+    the order every pre-PR-15 run used implicitly. ``overlap`` cuts
+    that dependence with a double-buffered stage loop: bucket b+1's
+    selection is issued with NO data dependency on bucket b's merge, so
+    XLA's latency-hiding scheduler interleaves the selection compute
+    with the in-flight ppermute rounds; merges still chain through a
+    barrier (one collective in flight — the schedule's round structure
+    is preserved). Both orders compute the SAME values through the SAME
+    ops (barriers are identity), so results — params, residuals,
+    telemetry counters — are bit-identical across serial/overlap for
+    every codec and schedule; only the exposed wall-clock differs.
+    ``auto`` prices both orders with the bucketing DP's span model and
+    keeps the cheaper (ties to serial).
 
     ``momentum_correction`` (TPU extension, DGC arXiv:1712.01887 §3.1-3.2
     — not reference parity: the reference runs torch momentum-SGD on the
@@ -359,6 +379,20 @@ def gtopk_sgd(
             f"--buckets {buckets!r} only applies to the layerwise mode "
             f"{LAYERWISE_MODES}; {mode!r} has a single wire set per step "
             "already (use --buckets concat)")
+    # Same build-time discipline for --pipeline: the spec parses (or
+    # fails) here; 'auto' resolves at trace time inside plan_buckets,
+    # where the partition and span model live. Overlap needs a bucket
+    # axis to pipeline over — a concat wire has ONE select and ONE
+    # merge per step, nothing to double-buffer ('auto' degrades to
+    # serial there instead of failing, because there is no decision to
+    # make).
+    pipeline_spec = parse_pipeline(pipeline)
+    if pipeline_spec == "overlap" and bucket_spec == "concat":
+        raise ValueError(
+            f"--pipeline overlap requires a bucketed layerwise wire "
+            f"(--buckets leaf|auto|<int B>); --buckets concat has a "
+            "single select/merge pair per step, so there are no stages "
+            "to overlap (use --pipeline serial or auto)")
     inner = optax.chain(
         optax.add_decayed_weights(weight_decay) if weight_decay else optax.identity(),
         # With momentum correction the velocity lives BEFORE the collective
@@ -471,16 +505,23 @@ def gtopk_sgd(
         # (the DP table is memoized), so boundaries are static
         # structure from here on, like offsets and ks.
         bplan = (plan_buckets(tuple(sizes), density, buckets=bucket_spec,
-                              p=p, codec=codec_spec, mode=mode)
+                              p=p, codec=codec_spec, mode=mode,
+                              pipeline=pipeline_spec)
                  if bucket_spec != "concat" else None)
         wire_k_total = bplan.k_total if bplan is not None else kk_total
+        # Resolved execution order for the bucketed stage loop below —
+        # plan_buckets decided an 'auto' spec against the span model;
+        # the concat wire has no stage loop and is serial by
+        # construction.
+        pipe = bplan.pipeline if bplan is not None else "serial"
         # Wire plan for this (mode, mesh, n, k, codec) — chosen by the
         # topology planner unless pinned; None at p=1 (no wire).
         # Bucketed runs key and score the candidates on the (n_b, k_b)
         # pairs — B merges each, not one concatenated merge.
         plan = (resolve_plan(mode, p, n, wire_k_total, codec_spec, 1,
                              comm_plan, None, buckets_key(bucket_spec),
-                             bplan.pairs() if bplan is not None else None)
+                             bplan.pairs() if bplan is not None else None,
+                             pipe)
                 if p > 1 else None)
 
         if correction:
@@ -742,41 +783,96 @@ def gtopk_sgd(
                 res_fl = tuple(bsplit(new_res))
                 u_out = tuple(bsplit(u_out_b)) if correction else us
                 return (dense_fl, res_fl, u_out) + tel
-            sel = [select_topk(s, kb, topk_method, residual=r)
-                   for s, r, kb in zip(bsrcs, bres, bks)]
-            idx_b = [i for _, i in sel]
-            vals_b = [v for v, _ in sel]
-            new_res = [a.at[i].set(0.0, mode="drop")
-                       for a, i in zip(accs, idx_b)]
-            # Momentum factor masking at the LOCAL (bucket) selection —
-            # same measured-ablation rationale as the other paths.
-            u_out_b = ([u.at[i].set(0.0, mode="drop")
-                        for u, i in zip(bus, idx_b)]
-                       if correction else [])
-            if codec.lossy:
-                # Wire-error fold per bucket: requantize in the
-                # bucket-local index space (the smaller n_b is exactly
-                # what shrinks the codec's index words) and fold the
-                # error into the bucket residual before the merge.
-                vq_b = [roundtrip_aligned(codec, v, i, n=nb)
-                        for v, i, nb in zip(vals_b, idx_b, bns)]
-                new_res = [r.at[i].add(v - vq, mode="drop")
-                           for r, i, v, vq in
-                           zip(new_res, idx_b, vals_b, vq_b)]
-                vals_b = vq_b
-            repaired, dense_bufs, rejected_b = [], [], []
-            for v, i, r, kb, nb in zip(vals_b, idx_b, new_res, bks, bns):
+            # --- Pipelined stage loop (--pipeline) ------------------
+            # Each bucket is two stages: _select (the fused two-stage
+            # local selection + error-feedback zero-out + codec error
+            # fold) and _merge (the codec-framed collective in the
+            # bucket-local index space + rejected-pick repair + dense
+            # scatter). Both execution orders below run the SAME ops on
+            # the SAME values — lax.optimization_barrier is the
+            # identity — and differ ONLY in the dependence edges handed
+            # to XLA's scheduler, so serial and overlap results
+            # (params, residuals, telemetry) are bit-identical by
+            # construction (test-pinned against the numpy oracle).
+
+            def _select(b, gate=None):
+                """Stage 1 of bucket b. ``gate`` (the serial pin) is a
+                pytree this stage must not start before: threading the
+                stage inputs through one optimization_barrier with it
+                makes every op of this stage depend on the gated
+                values."""
+                s, r = bsrcs[b], bres[b]
+                u = bus[b] if correction else None
+                if gate is not None:
+                    (s, r, u), _ = lax.optimization_barrier(
+                        ((s, r, u), gate))
+                v, i = select_topk(s, bks[b], topk_method, residual=r)
+                new_r = (s + r).at[i].set(0.0, mode="drop")
+                # Momentum factor masking at the LOCAL (bucket)
+                # selection — same measured-ablation rationale as the
+                # other paths.
+                u_out = u.at[i].set(0.0, mode="drop") if correction else None
+                if codec.lossy:
+                    # Wire-error fold per bucket: requantize in the
+                    # bucket-local index space (the smaller n_b is
+                    # exactly what shrinks the codec's index words) and
+                    # fold the error into the bucket residual before
+                    # the merge.
+                    vq = roundtrip_aligned(codec, v, i, n=bns[b])
+                    new_r = new_r.at[i].add(v - vq, mode="drop")
+                    v = vq
+                return {"v": v, "i": i, "res": new_r, "u": u_out}
+
+            def _merge(b, st):
+                """Stage 2 of bucket b: the collective, the
+                error-feedback repair of globally-rejected picks, and
+                the averaged dense scatter."""
                 gvals, gidx, _ = sparse_allreduce(
-                    mode, v, i, k=kb, n=nb,
+                    mode, st["v"], st["i"], k=bks[b], n=bns[b],
                     axis_name=axis_name, axis_size=p, codec=codec,
                     plan=plan,
                 )
-                rejected = ~membership_mask(i, gidx)
-                rejected_b.append(rejected)
-                repaired.append(
-                    r.at[i].add(jnp.where(rejected, v, 0.0),
-                                mode="drop"))
-                dense_bufs.append(scatter_add_dense(nb, gidx, gvals) / p)
+                rejected = ~membership_mask(st["i"], gidx)
+                return dict(
+                    st,
+                    rej=rejected,
+                    res=st["res"].at[st["i"]].add(
+                        jnp.where(rejected, st["v"], 0.0), mode="drop"),
+                    dense=scatter_add_dense(bns[b], gidx, gvals) / p)
+
+            outs = []
+            if pipe == "overlap" and B > 1:
+                # Double-buffered stage loop: bucket b+1's selection is
+                # issued with NO data dependency on bucket b's merge —
+                # the selection compute runs while the ppermute rounds
+                # are in flight — and the stage barrier makes merge b+1
+                # wait on BOTH (one collective in flight, preserving
+                # the schedule's round structure).
+                nxt = _select(0)
+                for b in range(B):
+                    cur = nxt
+                    nxt = _select(b + 1) if b + 1 < B else None
+                    out = _merge(b, cur)
+                    if nxt is not None:
+                        out, nxt = lax.optimization_barrier((out, nxt))
+                    outs.append(out)
+            else:
+                # Serial pin — the paper's strictly sequential
+                # T_select + T_comm, and the bit-identity oracle: the
+                # gate threads bucket b's merge outputs into bucket
+                # b+1's selection inputs, so exactly one stage can be
+                # in flight.
+                gate = None
+                for b in range(B):
+                    out = _merge(b, _select(b, gate))
+                    gate = (out["dense"], out["res"])
+                    outs.append(out)
+            idx_b = [o["i"] for o in outs]
+            vals_b = [o["v"] for o in outs]
+            rejected_b = [o["rej"] for o in outs]
+            repaired = [o["res"] for o in outs]
+            dense_bufs = [o["dense"] for o in outs]
+            u_out_b = [o["u"] for o in outs] if correction else []
             if correction and _restore_rejected_u:
                 # Ablation arm only — see the sparse_branch note.
                 u_out_b = [

@@ -43,6 +43,25 @@ Spec grammar (``--buckets``):
 
 The partition DP is O(L^2) states x O(L) transitions — microseconds for
 real models (L ~ 10^2) and run once at trace time, host-side.
+
+Pipeline axis (``--pipeline``, PR 15): under the historical ``serial``
+execution order a step pays sum_b (T_select_b + T_merge_b), and an
+extra bucket can only add alpha — which is why the serial DP honestly
+collapses `auto` to B=1. Under ``overlap`` bucket b+1's selection runs
+while bucket b's merge rounds are in flight, so the exposed span is the
+pipelined
+
+    T_select_1 + sum_{j=2..B} max(T_select_j, T_merge_{j-1}) + T_merge_B
+
+(first select is the fill, last merge the drain). The DP cannot
+optimize that non-additive span exactly, so under overlap pricing it
+minimizes the additive per-stage surrogate sum_b max(T_select_b,
+T_merge_b) — the standard software-pipeline relaxation, exact when
+stages are balanced — and `pipeline_span_ms` reports the true span for
+the chosen partition. Selection is priced linearly
+(`select_cost_ms`), so under SERIAL pricing the select term is
+partition-independent and the serial DP objective is unchanged from
+PR 11.
 """
 
 from __future__ import annotations
@@ -59,6 +78,42 @@ BUCKETS_DEFAULT = "concat"
 
 # Specs that are words, not counts. Anything else must parse as int >= 1.
 _WORD_SPECS = ("concat", "leaf", "auto")
+
+PIPELINE_DEFAULT = "serial"
+
+# --pipeline spec grammar: the two execution orders (modes.PIPELINES)
+# plus 'auto', which prices both and keeps the cheaper modeled span.
+_PIPELINE_SPECS = ("serial", "overlap", "auto")
+
+# Modeled per-element cost of the fused two-stage selection, in ms per
+# 1e6 elements. This is a MODELED constant, not a fit: selection is a
+# local bitonic/threshold pass whose throughput is device-bound, and
+# one ms per Melem sits in the measured band of the fused-variants
+# bench (benchmarks/results/fused_variants_*.json) without pretending
+# per-device precision. Linearity is the load-bearing property — it
+# makes sum_b select_cost_ms(n_b) independent of the partition, so the
+# serial DP objective (merge cost only) stays exact.
+SELECT_GAMMA_MS_PER_MELEM = 1.0
+
+
+def parse_pipeline(spec) -> str:
+    """Normalize a --pipeline spec: 'serial' | 'overlap' | 'auto'.
+
+    Raises ValueError on anything else — at build time, not inside the
+    jitted step."""
+    if isinstance(spec, str):
+        word = spec.strip().lower()
+        if word in _PIPELINE_SPECS:
+            return word
+    raise ValueError(
+        f"invalid --pipeline spec {spec!r}; grammar: serial | overlap | auto")
+
+
+def select_cost_ms(n_elems: int) -> float:
+    """Modeled ms of one bucket's fused two-stage selection (top-k over
+    an [n_b] operand). Linear in n_b by design — see
+    SELECT_GAMMA_MS_PER_MELEM."""
+    return SELECT_GAMMA_MS_PER_MELEM * float(n_elems) / 1e6
 
 
 def parse_buckets(spec) -> object:
@@ -112,6 +167,9 @@ class BucketPlan:
     leaf_sizes: Tuple[int, ...]
     ks: Tuple[int, ...]
     spec: str = "auto"
+    # Resolved execution order (modes.PIPELINES) — never the 'auto'
+    # spec word; plan_buckets resolves that before constructing a plan.
+    pipeline: str = PIPELINE_DEFAULT
 
     def __post_init__(self):
         L = len(self.leaf_sizes)
@@ -123,6 +181,10 @@ class BucketPlan:
         if len(self.ks) != len(b) - 1:
             raise ValueError(
                 f"{len(self.ks)} ks for {len(b) - 1} buckets")
+        if self.pipeline not in ("serial", "overlap"):
+            raise ValueError(
+                f"BucketPlan.pipeline must be a resolved execution order "
+                f"(serial|overlap), got {self.pipeline!r}")
 
     @property
     def n_buckets(self) -> int:
@@ -156,6 +218,7 @@ class BucketPlan:
             "bucket_boundaries": list(self.boundaries),
             "bucket_sizes": list(self.sizes),
             "bucket_ks": list(self.ks),
+            "pipeline": self.pipeline,
         }
 
     @staticmethod
@@ -175,6 +238,7 @@ class BucketPlan:
             leaf_sizes=tuple(int(s) for s in sizes),
             ks=tuple(int(k) for k in ks),
             spec=str(manifest.get("buckets", "auto")),
+            pipeline=str(manifest.get("pipeline", PIPELINE_DEFAULT)),
         )
 
 
@@ -209,16 +273,76 @@ def bucket_cost_ms(n_b: int, k_b: int, *, p: int, codec="fp32",
     return merge_rounds(p, schedule) * float(alpha_ms) + wire / beta_bytes_per_ms
 
 
+def stage_cost_ms(n_b: int, k_b: int, *, pipeline: str = PIPELINE_DEFAULT,
+                  p: int, codec="fp32", schedule: Optional[str] = None,
+                  alpha_ms: float, beta_gbps: float,
+                  mode: str = "gtopk_layerwise") -> float:
+    """The DP's per-bucket objective term under a given execution order.
+
+    serial: the merge cost alone. Selection is priced linearly
+    (select_cost_ms), so sum_b select_cost_ms(n_b) is the same for every
+    partition and adding it could never change the argmin — the PR 11
+    objective is preserved bit-for-bit.
+
+    overlap: max(T_select_b, T_merge_b) — the additive pipeline
+    surrogate. The true pipelined span (pipeline_span_ms) staggers
+    select_j against merge_{j-1} and is not additive over buckets; the
+    surrogate pairs each bucket's own two stages instead, which equals
+    the true span (up to fill/drain) when stages are balanced — the
+    standard software-pipeline relaxation that keeps the partition DP
+    exact over the surrogate."""
+    merge = bucket_cost_ms(n_b, k_b, p=p, codec=codec, schedule=schedule,
+                           alpha_ms=alpha_ms, beta_gbps=beta_gbps, mode=mode)
+    if pipeline == "overlap":
+        return max(select_cost_ms(n_b), merge)
+    return merge
+
+
 def partition_cost_ms(plan: BucketPlan, *, p: int, codec="fp32",
                       schedule: Optional[str] = None,
                       alpha_ms: float, beta_gbps: float,
-                      mode: str = "gtopk_layerwise") -> float:
-    """Total modeled comm ms of a partition — additive over buckets,
-    which is what makes the DP below exact."""
+                      mode: str = "gtopk_layerwise",
+                      pipeline: str = PIPELINE_DEFAULT) -> float:
+    """Total modeled objective of a partition — additive over buckets,
+    which is what makes the DP below exact. Under 'serial' this is the
+    summed merge cost (the PR 11 objective); under 'overlap' the summed
+    per-stage max (see stage_cost_ms)."""
     return sum(
+        stage_cost_ms(n_b, k_b, pipeline=pipeline, p=p, codec=codec,
+                      schedule=schedule, alpha_ms=alpha_ms,
+                      beta_gbps=beta_gbps, mode=mode)
+        for n_b, k_b in plan.pairs())
+
+
+def pipeline_span_ms(plan: BucketPlan, *, p: int, codec="fp32",
+                     schedule: Optional[str] = None, alpha_ms: float,
+                     beta_gbps: float, mode: str = "gtopk_layerwise",
+                     pipeline: Optional[str] = None) -> float:
+    """True modeled wall-clock span of one step's select+merge chain
+    under an execution order (defaults to the plan's own).
+
+    serial:  sum_b (T_select_b + T_merge_b) — the paper's sequential sum.
+    overlap: T_select_1 + sum_{j=2..B} max(T_select_j, T_merge_{j-1})
+             + T_merge_B — select_1 is the pipeline fill (nothing to
+             hide it under), merge_B the drain, and every interior step
+             exposes whichever of the two concurrent stages is longer.
+
+    This is the quantity `auto` pipeline resolution compares and the
+    span `report plan` / merge_bench print; the DP optimizes the
+    additive surrogate (stage_cost_ms) instead because this one is not
+    additive over buckets."""
+    pipe = plan.pipeline if pipeline is None else pipeline
+    sel = [select_cost_ms(n_b) for n_b in plan.sizes]
+    merge = [
         bucket_cost_ms(n_b, k_b, p=p, codec=codec, schedule=schedule,
                        alpha_ms=alpha_ms, beta_gbps=beta_gbps, mode=mode)
-        for n_b, k_b in plan.pairs())
+        for n_b, k_b in plan.pairs()]
+    if pipe != "overlap":
+        return sum(sel) + sum(merge)
+    span = sel[0]
+    for j in range(1, len(sel)):
+        span += max(sel[j], merge[j - 1])
+    return span + merge[-1]
 
 
 def _leaf_boundaries(n_leaves: int) -> Tuple[int, ...]:
@@ -228,7 +352,8 @@ def _leaf_boundaries(n_leaves: int) -> Tuple[int, ...]:
 @functools.lru_cache(maxsize=64)
 def _dp_tables(leaf_sizes: Tuple[int, ...], density: float, p: int,
                codec_name: str, schedule: Optional[str],
-               alpha_ms: float, beta_gbps: float, mode: str):
+               alpha_ms: float, beta_gbps: float, mode: str,
+               pipeline: str = PIPELINE_DEFAULT):
     """All-B partition DP over contiguous buckets.
 
     dp[b][i] = best (cost_ms, max_bucket_elems) of splitting the first i
@@ -250,9 +375,10 @@ def _dp_tables(leaf_sizes: Tuple[int, ...], density: float, p: int,
     def seg(j: int, i: int) -> Tuple[float, int]:
         n_b = prefix[i] - prefix[j]
         k_b = k_for_density(n_b, density)
-        return (bucket_cost_ms(n_b, k_b, p=p, codec=codec_name,
-                               schedule=schedule, alpha_ms=alpha_ms,
-                               beta_gbps=beta_gbps, mode=mode), n_b)
+        return (stage_cost_ms(n_b, k_b, pipeline=pipeline, p=p,
+                              codec=codec_name, schedule=schedule,
+                              alpha_ms=alpha_ms, beta_gbps=beta_gbps,
+                              mode=mode), n_b)
 
     INF = (math.inf, 0)
     dp: List[List[Tuple[float, int]]] = [[INF] * (L + 1) for _ in range(L + 1)]
@@ -291,7 +417,8 @@ def optimal_boundaries(leaf_sizes: Sequence[int], density: float, *,
                        n_buckets: Optional[int], p: int, codec="fp32",
                        schedule: Optional[str] = None, alpha_ms: float,
                        beta_gbps: float,
-                       mode: str = "gtopk_layerwise") -> Tuple[int, ...]:
+                       mode: str = "gtopk_layerwise",
+                       pipeline: str = PIPELINE_DEFAULT) -> Tuple[int, ...]:
     """Exact cost-minimal contiguous partition. ``n_buckets=None`` lets
     the DP choose B too; ties between bucket counts break toward the
     historical per-leaf end (LARGER B), so `auto` never coarsens the
@@ -303,7 +430,7 @@ def optimal_boundaries(leaf_sizes: Sequence[int], density: float, *,
     codec_name = getattr(codec, "name", codec)
     dp, arg, _ = _dp_tables(sizes, float(density), int(p), str(codec_name),
                             schedule, float(alpha_ms), float(beta_gbps),
-                            mode)
+                            mode, str(pipeline))
     if n_buckets is not None:
         b = max(1, min(int(n_buckets), L))
         return _backtrack(arg, b, L)
@@ -320,17 +447,26 @@ def plan_buckets(leaf_sizes: Sequence[int], density: float, *,
                  alpha_ms: Optional[float] = None,
                  beta_gbps: Optional[float] = None,
                  probe_dir: Optional[str] = None,
-                 mode: str = "gtopk_layerwise") -> Optional[BucketPlan]:
+                 mode: str = "gtopk_layerwise",
+                 pipeline: str = PIPELINE_DEFAULT) -> Optional[BucketPlan]:
     """Resolve a --buckets spec against a model's leaf sizes.
 
     Returns None for 'concat' (the historical single-merge wire — no
-    bucket axis exists there). 'leaf' and a pinned int are pure
-    structure; 'auto' (and the boundary placement of a pinned B) needs
-    alpha/beta — passed explicitly or read from the committed probe fit
-    via the planner's inputs (parallel.planner.planner_inputs)."""
+    bucket axis exists there, and therefore no pipeline axis either).
+    'leaf' and a pinned int are pure structure; 'auto' (and the
+    boundary placement of a pinned B) needs alpha/beta — passed
+    explicitly or read from the committed probe fit via the planner's
+    inputs (parallel.planner.planner_inputs).
+
+    ``pipeline`` resolution also lives here: 'serial'/'overlap' are
+    taken as pinned (the DP prices under that order); 'auto' runs the
+    DP under BOTH pricings, compares the true modeled spans
+    (pipeline_span_ms) of the two winners, and keeps the cheaper —
+    ties go to 'serial', the historical order."""
     spec = parse_buckets(buckets)
     if spec == "concat":
         return None
+    pipe = parse_pipeline(pipeline)
     sizes = tuple(int(s) for s in leaf_sizes)
     L = len(sizes)
     if L == 0:
@@ -341,11 +477,10 @@ def plan_buckets(leaf_sizes: Sequence[int], density: float, *,
             k_for_density(sum(sizes[lo:hi]), density)
             for lo, hi in zip(bounds, bounds[1:]))
 
-    if spec == "leaf":
-        bounds = _leaf_boundaries(L)
-        return BucketPlan(bounds, sizes, per_bucket_ks(bounds), spec="leaf")
-
-    if alpha_ms is None or beta_gbps is None:
+    # 'leaf' structure needs no pricing, but resolving pipeline 'auto'
+    # still does; only fetch probe inputs when something will use them.
+    needs_pricing = spec != "leaf" or pipe == "auto"
+    if needs_pricing and (alpha_ms is None or beta_gbps is None):
         # Late import: planner imports ledger, and pulling it at module
         # import time would cycle through parallel/__init__.
         from .planner import planner_inputs
@@ -353,13 +488,37 @@ def plan_buckets(leaf_sizes: Sequence[int], density: float, *,
         alpha_ms = inputs["alpha_ms"] if alpha_ms is None else alpha_ms
         beta_gbps = inputs["beta_gbps"] if beta_gbps is None else beta_gbps
 
+    def span(plan: BucketPlan) -> float:
+        return pipeline_span_ms(plan, p=p, codec=codec, schedule=schedule,
+                                alpha_ms=alpha_ms, beta_gbps=beta_gbps,
+                                mode=mode)
+
+    if spec == "leaf":
+        bounds = _leaf_boundaries(L)
+        plans = [BucketPlan(bounds, sizes, per_bucket_ks(bounds),
+                            spec="leaf", pipeline=pp)
+                 for pp in (("serial", "overlap") if pipe == "auto"
+                            else (pipe,))]
+        # Strict < keeps 'serial' (listed first) on ties.
+        return min(plans, key=span) if len(plans) > 1 else plans[0]
+
     n_target = None if spec == "auto" else int(spec)
-    bounds = optimal_boundaries(
-        sizes, density, n_buckets=n_target, p=p, codec=codec,
-        schedule=schedule, alpha_ms=alpha_ms, beta_gbps=beta_gbps,
-        mode=mode)
-    return BucketPlan(bounds, sizes, per_bucket_ks(bounds),
-                      spec=buckets_key(spec))
+
+    def solve(pp: str) -> BucketPlan:
+        bounds = optimal_boundaries(
+            sizes, density, n_buckets=n_target, p=p, codec=codec,
+            schedule=schedule, alpha_ms=alpha_ms, beta_gbps=beta_gbps,
+            mode=mode, pipeline=pp)
+        return BucketPlan(bounds, sizes, per_bucket_ks(bounds),
+                          spec=buckets_key(spec), pipeline=pp)
+
+    if pipe != "auto":
+        return solve(pipe)
+    serial_plan, overlap_plan = solve("serial"), solve("overlap")
+    # min() keeps the first argument on ties — serial, the historical
+    # order, so 'auto' only pipelines when the modeled span strictly
+    # improves.
+    return min((serial_plan, overlap_plan), key=span)
 
 
 def describe(plan: BucketPlan, *, p: int, codec="fp32",
@@ -367,10 +526,14 @@ def describe(plan: BucketPlan, *, p: int, codec="fp32",
              beta_gbps: float,
              mode: str = "gtopk_layerwise") -> List[dict]:
     """Per-bucket rows for `report plan` / the bench: leaf range, elems,
-    wire k, modeled bytes and ms."""
+    wire k, modeled bytes and ms (merge, select, and the pipeline-stage
+    term the DP priced)."""
     rows = []
     for b, (n_b, k_b) in enumerate(plan.pairs()):
         lo, hi = plan.leaf_range(b)
+        merge_ms = bucket_cost_ms(
+            n_b, k_b, p=p, codec=codec, schedule=schedule,
+            alpha_ms=alpha_ms, beta_gbps=beta_gbps, mode=mode)
         rows.append({
             "bucket": b,
             "leaves": f"{lo}:{hi}",
@@ -380,8 +543,9 @@ def describe(plan: BucketPlan, *, p: int, codec="fp32",
             "wire_bytes": comm_bytes_per_step(
                 mode, n_b, k_b, p, codec=getattr(codec, "name", codec),
                 schedule=schedule),
-            "modeled_ms": bucket_cost_ms(
-                n_b, k_b, p=p, codec=codec, schedule=schedule,
-                alpha_ms=alpha_ms, beta_gbps=beta_gbps, mode=mode),
+            "modeled_ms": merge_ms,
+            "select_ms": select_cost_ms(n_b),
+            "stage_ms": (max(select_cost_ms(n_b), merge_ms)
+                         if plan.pipeline == "overlap" else merge_ms),
         })
     return rows

@@ -71,7 +71,12 @@ class CommPlan:
     ``bucketing`` the layerwise merge granularity
     (parallel.bucketing.buckets_key grammar: 'concat' = the historical
     single concatenated merge, 'leaf' = one merge per leaf, 'b{B}' /
-    'auto' = the DP partition). The name is the plan grammar the
+    'auto' = the DP partition), ``pipeline`` the RESOLVED execution
+    order of the bucketed select/merge chain (modes.PIPELINES —
+    'serial' is the historical strictly-sequential step, 'overlap' the
+    double-buffered stage loop; resolution of an 'auto' spec happens
+    upstream in parallel.bucketing.plan_buckets, the planner carries
+    and records the outcome). The name is the plan grammar the
     ``--comm-plan`` flag speaks.
     """
 
@@ -82,6 +87,7 @@ class CommPlan:
     codec: str = "fp32"
     ici_size: int = 1
     bucketing: str = "concat"
+    pipeline: str = "serial"
 
     @property
     def wire_mode(self) -> str:
@@ -97,13 +103,14 @@ def _norm_mode(mode: Optional[str]) -> str:
 
 
 def candidate_plans(mode: Optional[str], *, codec: str = "fp32",
-                    ici_size: int = 1,
-                    bucketing: str = "concat") -> Tuple[CommPlan, ...]:
+                    ici_size: int = 1, bucketing: str = "concat",
+                    pipeline: str = "serial") -> Tuple[CommPlan, ...]:
     """Every wire plan that realizes ``mode``'s semantics, historical
     default FIRST (selection uses a stable min, so the default wins all
-    ties and all model-indifferent regimes). ``bucketing`` is carried on
-    the gtopk-family candidates only — it is a layerwise merge
-    granularity, orthogonal to which schedule each merge runs."""
+    ties and all model-indifferent regimes). ``bucketing``/``pipeline``
+    are carried on the gtopk-family candidates only — they are layerwise
+    merge granularity / execution order, orthogonal to which schedule
+    each merge runs."""
     m = _norm_mode(mode)
     if m in DENSE_MODES:
         return (CommPlan("dense", m, "psum", "none", codec, 1),)
@@ -116,9 +123,10 @@ def candidate_plans(mode: Optional[str], *, codec: str = "fp32",
         return (CommPlan("hier", m, "tree", "psum", codec,
                          max(1, ici_size)),)
     if m in GTOPK_MODES or m in LAYERWISE_MODES:
-        return (CommPlan("tree", m, "tree", "none", codec, 1, bucketing),
+        return (CommPlan("tree", m, "tree", "none", codec, 1, bucketing,
+                         pipeline),
                 CommPlan("balanced", m, "balanced", "none", codec, 1,
-                         bucketing))
+                         bucketing, pipeline))
     raise ValueError(f"unknown mode {mode!r}")
 
 
@@ -193,6 +201,7 @@ class PlanDecision:
             "mode": self.plan.mode,
             "intra": self.plan.intra,
             "bucketing": self.plan.bucketing,
+            "pipeline": self.plan.pipeline,
             "pin": self.pin,
             # numeric so the gate smoke can pin "defaults kept the
             # historical wire" as a baseline check
@@ -211,8 +220,8 @@ def build_decision(mode: Optional[str], *, p: int, n: int, k: int,
                    ici_gbps: Optional[float] = None,
                    bucketing: str = "concat",
                    buckets: Optional[Tuple[Tuple[int, int], ...]] = None,
-                   fit_source: Optional[str] = None
-                   ) -> PlanDecision:
+                   fit_source: Optional[str] = None,
+                   pipeline: str = "serial") -> PlanDecision:
     """Score every candidate plan for (mode, mesh, n, k, codec) and pick
     one: the pinned plan when ``pin`` names one, else the cheapest under
     the model (stable min — the historical default wins ties). Explicit
@@ -223,7 +232,13 @@ def build_decision(mode: Optional[str], *, p: int, n: int, k: int,
     ``bucketing``/``buckets`` (the resolved --buckets key and the
     BucketPlan's (n_b, k_b) pairs) make the candidate scores price the
     bucketed wire — B merges, each over its bucket-local index space —
-    instead of the single concatenated merge."""
+    instead of the single concatenated merge. ``pipeline`` is the
+    RESOLVED execution order (plan_buckets already decided an 'auto'
+    spec); the decision still selects the schedule by comm_ms — the
+    wire cost is what the schedule controls — but every candidate row
+    also records span_serial_ms/span_overlap_ms, the step-span the two
+    execution orders would expose under that schedule, so the recorded
+    decision shows what overlap bought."""
     pin = validate_pin(pin, mode, ici_size=ici_size)
     inputs = planner_inputs(probe_dir)
     override_source = fit_source if fit_source is not None else "arg"
@@ -236,7 +251,17 @@ def build_decision(mode: Optional[str], *, p: int, n: int, k: int,
     if ici_gbps is not None:
         inputs["ici_gbps"] = float(ici_gbps)
     cands = candidate_plans(mode, codec=codec, ici_size=ici_size,
-                            bucketing=bucketing)
+                            bucketing=bucketing, pipeline=pipeline)
+    # Span pricing needs the bucket shapes; a concat/unbucketed wire is
+    # one bucket of the full (n, k) — both execution orders then expose
+    # the same span (a B=1 pipeline has nothing to overlap), which is
+    # exactly the honest answer for that wire.
+    from gtopkssgd_tpu.parallel import bucketing as _bucketing
+    span_pairs = buckets if buckets else ((n, k),)
+    span_plan = _bucketing.BucketPlan(
+        boundaries=tuple(range(len(span_pairs) + 1)),
+        leaf_sizes=tuple(nb for nb, _ in span_pairs),
+        ks=tuple(kb for _, kb in span_pairs))
     scored: List[Dict[str, Any]] = []
     for cand in cands:
         ms = score_plan(cand, p, n=n, k=k, alpha_ms=inputs["alpha_ms"],
@@ -252,10 +277,19 @@ def build_decision(mode: Optional[str], *, p: int, n: int, k: int,
             comm_bytes_per_step(cand.mode, n, k, p,
                                 ici_size=cand.ici_size, codec=cand.codec,
                                 schedule=cand.schedule))
+        spans = {
+            pipe: _bucketing.pipeline_span_ms(
+                span_plan, p=p, codec=cand.codec,
+                schedule=cand.schedule, alpha_ms=inputs["alpha_ms"],
+                beta_gbps=inputs["beta_gbps"], mode=cand.mode,
+                pipeline=pipe)
+            for pipe in ("serial", "overlap")}
         scored.append({
             "name": cand.name, "schedule": cand.schedule,
             "wire_mode": cand.wire_mode, "comm_ms": round(ms, 6),
             "wire_bytes": wire_bytes,
+            "span_serial_ms": round(spans["serial"], 6),
+            "span_overlap_ms": round(spans["overlap"], 6),
         })
     if pin != "auto":
         chosen = next(c for c in cands if c.name == pin)
@@ -274,15 +308,15 @@ def resolve_plan(mode: Optional[str], p: int, n: int, k: int,
                  pin: Optional[str] = "auto",
                  probe_dir: Optional[str] = None,
                  bucketing: str = "concat",
-                 buckets: Optional[Tuple[Tuple[int, int], ...]] = None
-                 ) -> CommPlan:
+                 buckets: Optional[Tuple[Tuple[int, int], ...]] = None,
+                 pipeline: str = "serial") -> CommPlan:
     """The optimizer's trace-time entry point: (mode, mesh, n, k, codec,
     pin) -> CommPlan, memoized — the decision is made once per distinct
     shape, never per step, and retracing costs a dict lookup. The
-    bucketing key and (n_b, k_b) pairs are part of the memo key, so a
-    bucketed and an unbucketed run of the same shape resolve
-    independently."""
+    bucketing key, (n_b, k_b) pairs, and resolved pipeline are part of
+    the memo key, so a bucketed and an unbucketed run of the same shape
+    resolve independently."""
     return build_decision(mode, p=p, n=n, k=k, codec=codec,
                           ici_size=ici_size, pin=pin,
                           probe_dir=probe_dir, bucketing=bucketing,
-                          buckets=buckets).plan
+                          buckets=buckets, pipeline=pipeline).plan

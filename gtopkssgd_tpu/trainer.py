@@ -101,6 +101,14 @@ class TrainConfig:
                                    # wire; 'leaf' = one merge per param
                                    # leaf; 'auto'/B = alpha-beta-optimal
                                    # byte-balanced contiguous buckets
+    pipeline: str = "serial"       # bucketed layerwise only: bucket
+                                   # execution order (modes.PIPELINES +
+                                   # 'auto'). 'serial' = the paper's
+                                   # sequential select->merge chain;
+                                   # 'overlap' = double-buffered stages
+                                   # (bucket b+1's selection runs under
+                                   # bucket b's merge), bit-identical;
+                                   # 'auto' = cheaper modeled span wins
     clip_grad_norm: Optional[float] = None  # default: LSTMs clip (ref §3.4)
     nsteps_update: int = 1
     warmup_epochs: int = 0         # linear LR ramp over the first N epochs
@@ -542,7 +550,8 @@ class Trainer:
                     for leaf in jax.tree_util.tree_leaves(self.state.params))
                 self._bucket_plan = plan_buckets(
                     leaf_sizes, cfg.density, buckets=cfg.buckets,
-                    p=self.p, codec=cfg.wire_codec)
+                    p=self.p, codec=cfg.wire_codec,
+                    pipeline=cfg.pipeline)
         if cfg.compression not in (None, "none", "dense") and self.p > 1:
             from gtopkssgd_tpu.parallel import build_decision
             from gtopkssgd_tpu.parallel.bucketing import buckets_key
@@ -560,6 +569,8 @@ class Trainer:
                 pin=cfg.comm_plan,
                 bucketing=buckets_key(cfg.buckets),
                 buckets=bplan.pairs() if bplan is not None else None,
+                pipeline=(bplan.pipeline if bplan is not None
+                          else "serial"),
                 **fit_kw)
         if (self._comm_fit is not None and self._plan_decision is not None
                 and self._plan_decision.pin == "auto"):
@@ -691,8 +702,10 @@ class Trainer:
         sizes = bplan.leaf_sizes
 
         def _ms(spec):
-            alt = plan_buckets(sizes, cfg.density, buckets=spec, **kw)
-            return bucketing.partition_cost_ms(alt, **kw)
+            alt = plan_buckets(sizes, cfg.density, buckets=spec,
+                               pipeline=bplan.pipeline, **kw)
+            return bucketing.partition_cost_ms(
+                alt, pipeline=bplan.pipeline, **kw)
 
         return {
             "buckets": bplan.spec,
@@ -701,10 +714,18 @@ class Trainer:
             "boundaries": list(bplan.boundaries),
             "bucket_sizes": list(bplan.sizes),
             "bucket_ks": list(bplan.ks),
+            "pipeline": bplan.pipeline,
             "rows": bucketing.describe(bplan, **kw),
-            "modeled_ms": bucketing.partition_cost_ms(bplan, **kw),
+            "modeled_ms": bucketing.partition_cost_ms(
+                bplan, pipeline=bplan.pipeline, **kw),
             "modeled_ms_b1": _ms(1),
             "modeled_ms_leaf": _ms("leaf"),
+            # True wall-clock spans under both orders — the A/B a report
+            # reader needs to see what pipelining bought at this B.
+            "span_serial_ms": bucketing.pipeline_span_ms(
+                bplan, pipeline="serial", **kw),
+            "span_overlap_ms": bucketing.pipeline_span_ms(
+                bplan, pipeline="overlap", **kw),
             "alpha_ms": alpha,
             "beta_gbps": beta,
         }
@@ -737,8 +758,14 @@ class Trainer:
         wire = float(telemetry_scalars(tel).get("wire_bytes", 0.0))
         if wire <= 0:
             return
+        # Overlapped dispatches measure a partially-hidden t_comm; tag
+        # them so the calibrator quarantines the sample instead of
+        # biasing the serial alpha-beta fit (obs/calib.py).
+        overlapped = (self._bucket_plan is not None
+                      and self._bucket_plan.pipeline == "overlap")
         self.calib.observe(step, wire_bytes=wire,
-                           t_comm_ms=float(t_comm_us) / 1e3 / spd)
+                           t_comm_ms=float(t_comm_us) / 1e3 / spd,
+                           overlapped=overlapped)
 
     def _make_tx(self, warmup_dense_steps: Optional[int] = None):
         """The optimizer transform; ``warmup_dense_steps`` overrides the
@@ -760,6 +787,7 @@ class Trainer:
             wire_codec=cfg.wire_codec,
             comm_plan=self._comm_plan_pin or cfg.comm_plan,
             buckets=cfg.buckets,
+            pipeline=cfg.pipeline,
             clip_grad_norm=cfg.clip_grad_norm,
             axis_name="dp" if self.p > 1 else None,
             hier_ici_size=cfg.hier_ici,

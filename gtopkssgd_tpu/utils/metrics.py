@@ -74,6 +74,9 @@ KINDS = frozenset({
     "regress",     # cross-run regression evidence row (gate smoke):
                    # registry regress exit codes + fitted-vs-true check
                    # against obs/registry.py's runs.jsonl baseline
+    "overlap",     # pipelined-vs-serial A/B evidence row (gate smoke):
+                   # bit-identity deltas, measured overlap_frac from the
+                   # trace capture, and the DP's B>1 crossover pin
     "compile",     # compile-plane accounting (obs/memwatch.py): one
                    # record per distinct dispatch shape (cost/memory
                    # analysis + lower/compile wall times) and one per

@@ -316,3 +316,206 @@ def test_collective_count_telemetry():
                        telemetry=True)
         _, state = _spmd_run(tx, params, mesh, p, 1, seed=19)
         assert float(state.telemetry["collective_count"]) == want, buckets
+
+
+# ----------------------------------------------------------- pipeline
+
+def test_parse_pipeline_grammar():
+    assert bucketing.parse_pipeline("serial") == "serial"
+    assert bucketing.parse_pipeline("overlap") == "overlap"
+    assert bucketing.parse_pipeline(" Auto ") == "auto"
+    for bad in ("", "pipelined", "concat", None, 1, 1.5):
+        with pytest.raises(ValueError):
+            bucketing.parse_pipeline(bad)
+
+
+def test_overlap_requires_bucketed_wire():
+    # One concatenated merge has nothing to overlap with: fail loudly at
+    # build time instead of silently running serial.
+    with pytest.raises(ValueError):
+        gtopk_sgd(0.1, compression="gtopk_layerwise", density=0.1,
+                  buckets="concat", pipeline="overlap")
+    # non-layerwise modes force concat, so overlap is rejected there too
+    with pytest.raises(ValueError):
+        gtopk_sgd(0.1, compression="gtopk", density=0.1,
+                  pipeline="overlap")
+    # 'auto' degrades to serial on the concat wire (nothing to compare)
+    gtopk_sgd(0.1, compression="gtopk_layerwise", density=0.1,
+              buckets="concat", pipeline="auto")
+    gtopk_sgd(0.1, compression="gtopk_layerwise", density=0.1,
+              buckets=2, pipeline="overlap")
+
+
+def test_plan_rejects_unresolved_pipeline():
+    # A constructed plan must carry a RESOLVED order — 'auto' is a spec
+    # word for plan_buckets, never a plan state.
+    with pytest.raises(ValueError):
+        BucketPlan((0, 2, 4), (32, 5, 6, 12), (1, 1), pipeline="auto")
+
+
+def test_manifest_carries_pipeline():
+    sizes = (32, 5, 6, 12)
+    plan = bucketing.plan_buckets(sizes, 0.125, buckets=2, p=4,
+                                  alpha_ms=1.0, beta_gbps=0.6,
+                                  pipeline="overlap")
+    assert plan.pipeline == "overlap"
+    back = BucketPlan.from_manifest(
+        json.loads(json.dumps(plan.to_manifest())))
+    assert back.pipeline == "overlap"
+    # pre-pipeline manifests default to the historical serial order
+    man = plan.to_manifest()
+    del man["pipeline"]
+    assert BucketPlan.from_manifest(man).pipeline == "serial"
+
+
+def test_stage_cost_and_span_formulas():
+    kw = dict(p=4, codec="fp32", schedule=None, alpha_ms=1.0,
+              beta_gbps=0.6, mode="gtopk_layerwise")
+    n_b, k_b = 2_000_000, 2_000
+    merge = bucketing.bucket_cost_ms(n_b, k_b, **kw)
+    sel = bucketing.select_cost_ms(n_b)
+    assert sel == pytest.approx(2.0)  # 1 ms/Melem * 2 Melem
+    assert bucketing.stage_cost_ms(
+        n_b, k_b, pipeline="serial", **kw) == pytest.approx(merge)
+    assert bucketing.stage_cost_ms(
+        n_b, k_b, pipeline="overlap", **kw) == pytest.approx(
+            max(sel, merge))
+    # span: serial is the paper's sequential sum; overlap is fill +
+    # interior maxes + drain. Hand-compute over a pinned 3-bucket plan.
+    sizes = (1_000_000, 3_000_000, 2_000_000)
+    plan = BucketPlan(
+        (0, 1, 2, 3), sizes,
+        tuple(bucketing.k_for_density(s, 0.001) for s in sizes),
+        pipeline="overlap")
+    sels = [bucketing.select_cost_ms(s) for s in sizes]
+    merges = [bucketing.bucket_cost_ms(n, k, **kw)
+              for n, k in plan.pairs()]
+    want_serial = sum(sels) + sum(merges)
+    want_overlap = (sels[0] + max(sels[1], merges[0])
+                    + max(sels[2], merges[1]) + merges[2])
+    assert bucketing.pipeline_span_ms(
+        plan, pipeline="serial", **kw) == pytest.approx(want_serial)
+    assert bucketing.pipeline_span_ms(plan, **kw) == pytest.approx(
+        want_overlap)  # defaults to the plan's own order
+    assert want_overlap < want_serial
+
+
+def test_dp_crossover_overlap_opens_buckets():
+    # The acceptance crossover, pinned on synthetic leaves: at ICI-class
+    # alpha the overlap-priced DP opens B > 1 (per-stage max lets the
+    # fixed select cost absorb extra per-bucket latency) while serial
+    # pricing keeps the single merge; 'auto' takes the overlapped order
+    # because its true modeled span is strictly smaller.
+    sizes = (1_000_000,) * 8
+    kw = dict(p=8, codec="fp32", alpha_ms=0.1, beta_gbps=0.6)
+    serial = bucketing.plan_buckets(sizes, 0.001, buckets="auto",
+                                    pipeline="serial", **kw)
+    overlap = bucketing.plan_buckets(sizes, 0.001, buckets="auto",
+                                     pipeline="overlap", **kw)
+    auto = bucketing.plan_buckets(sizes, 0.001, buckets="auto",
+                                  pipeline="auto", **kw)
+    assert serial.n_buckets == 1
+    assert overlap.n_buckets == 8
+    assert auto.pipeline == "overlap" and auto.n_buckets == 8
+    assert (bucketing.pipeline_span_ms(overlap, **kw)
+            < bucketing.pipeline_span_ms(serial, **kw))
+    # latency-bound regime: both orders collapse to B=1, the spans tie,
+    # and 'auto' keeps the historical serial order.
+    kw22 = dict(kw, alpha_ms=22.0)
+    auto22 = bucketing.plan_buckets(sizes, 0.001, buckets="auto",
+                                    pipeline="auto", **kw22)
+    assert auto22.pipeline == "serial" and auto22.n_buckets == 1
+
+
+def test_describe_rows_carry_stage_terms():
+    sizes = (32, 5, 6, 12)
+    kw = dict(p=4, alpha_ms=1.0, beta_gbps=0.6)
+    for pipe in ("serial", "overlap"):
+        plan = bucketing.plan_buckets(sizes, 0.125, buckets=2,
+                                      pipeline=pipe, **kw)
+        for r in bucketing.describe(plan, **kw):
+            assert r["select_ms"] == pytest.approx(
+                bucketing.select_cost_ms(r["elems"]))
+            want = (max(r["select_ms"], r["modeled_ms"])
+                    if pipe == "overlap" else r["modeled_ms"])
+            assert r["stage_ms"] == pytest.approx(want)
+
+
+@pytest.mark.parametrize("p", [2, 3, 5])
+@pytest.mark.parametrize("codec", ["fp32", "int8:16"])
+@pytest.mark.parametrize("plan", ["tree", "balanced"])
+def test_overlap_bit_equals_serial(p, codec, plan):
+    # THE pipeline contract: optimization_barrier is the identity, so
+    # reordering the stage issue order must change NOTHING — updates,
+    # error-feedback residuals (codec error scatter-back included), and
+    # telemetry counters bit-equal across 3 steps, for both schedules.
+    params = tree_params()
+    mesh = make_mesh(p)
+    kw = dict(momentum=0.9, density=0.125, wire_codec=codec,
+              comm_plan=plan, axis_name="dp", axis_size=p,
+              telemetry=True)
+    tx_s = gtopk_sgd(0.5, compression="gtopk_layerwise", buckets=2,
+                     pipeline="serial", **kw)
+    tx_o = gtopk_sgd(0.5, compression="gtopk_layerwise", buckets=2,
+                     pipeline="overlap", **kw)
+    u_s, s_s = _spmd_run(tx_s, params, mesh, p, 3, seed=23)
+    u_o, s_o = _spmd_run(tx_o, params, mesh, p, 3, seed=23)
+    for a, b in zip(u_s, u_o):
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    for ra, rb in zip(s_s.residual, s_o.residual):
+        np.testing.assert_array_equal(np.asarray(ra), np.asarray(rb))
+    for key in s_s.telemetry:
+        np.testing.assert_array_equal(
+            np.asarray(s_s.telemetry[key]), np.asarray(s_o.telemetry[key]))
+
+
+def test_overlap_first_step_matches_numpy_oracle():
+    # Independent numpy simulation of one bucketed step (residuals start
+    # at zero, momentum off): per-rank exact top-k per bucket, the
+    # recursive-doubling gtopk merge oracle, dense-average, -lr scale.
+    # The overlapped pipeline must land on the same dense update.
+    p, lr, density = 2, 0.5, 0.125
+    params = tree_params()
+    mesh = make_mesh(p)
+    tx = gtopk_sgd(lr, momentum=0.0, compression="gtopk_layerwise",
+                   buckets=2, pipeline="overlap", density=density,
+                   axis_name="dp", axis_size=p)
+    ups, _ = _spmd_run(tx, params, mesh, p, 1, seed=29)
+
+    names = sorted(params)
+    sizes = tuple(int(params[n].size) for n in names)
+    plan = bucketing.plan_buckets(sizes, density, buckets=2, p=p)
+    rng = np.random.default_rng(29)
+    grads = rand_grads(rng, params, lead=(p,))
+
+    def np_topk(x, k):
+        idx = np.argsort(-np.abs(x), kind="stable")[:k]
+        return x[idx].astype(np.float32), idx.astype(np.int32)
+
+    def np_merge(va, ia, vb, ib, k, n):
+        dense = np.zeros(n + 1, np.float64)
+        np.add.at(dense, ia, va)
+        np.add.at(dense, ib, vb)
+        dense[n] = 0.0
+        v, i = np_topk(dense[:n], k)
+        return v, np.where(v == 0, n, i).astype(np.int32)
+
+    got = np.concatenate([np.asarray(ups[0][n]).reshape(-1)
+                          for n in names])
+    want = np.zeros(sum(sizes), np.float64)
+    for b, (n_b, k_b) in enumerate(plan.pairs()):
+        lo, hi = plan.leaf_range(b)
+        off = sum(sizes[:lo])
+        picks = []
+        for d in range(p):
+            flat = np.concatenate(
+                [np.asarray(grads[n][d]).reshape(-1)
+                 for n in names[lo:hi]]).astype(np.float32)
+            picks.append(np_topk(flat, k_b))
+        (v0, i0), (v1, i1) = picks
+        gv, gi = np_merge(v0, i0, v1, i1, k_b, n_b)
+        dense = np.zeros(n_b + 1, np.float64)
+        np.add.at(dense, gi, gv)
+        want[off:off + n_b] = -lr * dense[:n_b] / p
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)

@@ -179,6 +179,36 @@ def test_drift_rule_honors_halt_on_after_durable_record(tmp_path):
     assert kinds.index("calib") < kinds.index("event")
 
 
+def test_calibrator_quarantines_overlapped_samples():
+    """PR 15: samples measured under the overlapped pipeline report the
+    EXPOSED comm span (part of the wire time hidden under selection), so
+    the per-message alpha-beta inversion does not hold for them. They
+    must never enter the serial fit — here every overlapped sample is
+    corrupted to a third of the true time, and the fit still recovers
+    the ground truth exactly."""
+    c = CommCalibrator("gtopk", 4, refit_interval=8, min_samples=4,
+                       fit_window=8, max_samples=8)
+    rec = None
+    for i, (msgs, b, t) in enumerate(_stream(n=16)):
+        # an overlapped twin of every serial sample, 3x too fast
+        assert c.observe(i, b, t / 3.0, overlapped=True) is None
+        rec = c.observe(i, b, t) or rec
+    assert len(c.samples) == 8                    # trimmed to max_samples
+    assert len(c.overlap_samples) == 8            # quarantined AND trimmed
+    assert all(s[2] < min(x[2] for x in c.samples)
+               for s in c.overlap_samples)        # the fast twins, apart
+    assert rec is not None
+    assert rec["n_overlap_excluded"] == 8
+    assert rec["alpha_fit_ms"] == pytest.approx(TRUE_ALPHA, rel=1e-9)
+    assert rec["beta_fit_gbps"] == pytest.approx(TRUE_BETA, rel=1e-9)
+    # overlapped observes never advance the refit window: 16 tagged
+    # samples alone produce no fit at all
+    c2 = CommCalibrator("gtopk", 4, refit_interval=4, min_samples=4)
+    for i, (msgs, b, t) in enumerate(_stream(n=16)):
+        assert c2.observe(i, b, t, overlapped=True) is None
+    assert c2.samples == [] and c2.fits == []
+
+
 # ------------------------------------------- artifact + the closed loop
 
 def test_artifact_roundtrips_through_planner_inputs(tmp_path):
@@ -319,6 +349,87 @@ def test_run_summary_distills_the_stream():
     assert st["wire_bytes_per_step"] == pytest.approx(1e6)
     # no manifest -> nothing to key on
     assert obs_registry.run_summary(_run_records()[1:]) is None
+
+
+def test_run_summary_carries_pipeline_shape():
+    """PR 15 plan-shape stats: pipeline from the plan record (the
+    decision as executed), n_buckets from the manifest's bucket_ks, and
+    overlap_frac averaged over the attr records."""
+    recs = _run_records()
+    recs[0]["bucket_ks"] = [120, 80, 56]
+    recs.insert(1, {"kind": "plan", "time": 100.5, "rank": 0,
+                    "name": "tree", "pipeline": "overlap"})
+    for rec, f in zip([r for r in recs if r.get("kind") == "attr"],
+                      (0.5,)):
+        rec["overlap_frac"] = f
+    recs.append({"kind": "attr", "time": 102.8, "rank": 0,
+                 "t_comm_us": 100.0, "t_total_us": 1000.0,
+                 "overlap_frac": 0.7})
+    st = obs_registry.run_summary(recs)["stats"]
+    assert st["pipeline"] == "overlap"
+    assert st["n_buckets"] == 3
+    assert st["overlap_frac"] == pytest.approx(0.6)
+    # no plan record -> the manifest stamp is the fallback
+    plain = _run_records()
+    plain[0]["pipeline"] = "serial"
+    st2 = obs_registry.run_summary(plain)["stats"]
+    assert st2["pipeline"] == "serial"
+    assert "overlap_frac" not in st2 and "n_buckets" not in st2
+    # the history table prints the three new columns for every entry
+    entry = obs_registry.run_summary(recs)
+    (row,) = obs_registry.history_rows([entry])
+    assert len(row) == len(obs_registry.HISTORY_HEADER)
+    hdr = obs_registry.HISTORY_HEADER
+    assert row[hdr.index("pipeline")] == "overlap"
+    assert row[hdr.index("B")] == "3"
+    assert row[hdr.index("ovl_frac")] == "0.6000"
+    (row2,) = obs_registry.history_rows([obs_registry.run_summary(plain)])
+    assert row2[hdr.index("pipeline")] == "serial"
+    assert row2[hdr.index("B")] == "-"
+
+
+def test_regress_pins_pipeline_and_bucket_shape():
+    """The exact-string loop: a pipeline flipped serial<->overlap under
+    the same config is a plan regression; overlap_frac gets a purely
+    absolute 0.1 slack so a serial 0.0 baseline still bounds the run;
+    n_buckets is exact."""
+    base = _entry(pipeline="overlap", n_buckets=4, overlap_frac=0.6)
+
+    def _status(cur, field):
+        rows, failures = obs_registry.regress(cur, base)
+        return {r[0]: r[4] for r in rows}[field], failures
+
+    same = _entry(pipeline="overlap", n_buckets=4, overlap_frac=0.62)
+    st, fails = _status(same, "pipeline")
+    assert st == "ok" and fails == 0
+    # pipeline silently collapsed back to serial -> FAIL
+    st, fails = _status(
+        _entry(pipeline="serial", n_buckets=4, overlap_frac=0.62),
+        "pipeline")
+    assert st == "FAIL" and fails >= 1
+    # pipeline vanished entirely -> MISSING
+    st, fails = _status(_entry(n_buckets=4, overlap_frac=0.62), "pipeline")
+    assert st == "MISSING" and fails >= 1
+    # overlap collapsed past the 0.1 absolute slack -> FAIL
+    st, _ = _status(
+        _entry(pipeline="overlap", n_buckets=4, overlap_frac=0.45),
+        "overlap_frac")
+    assert st == "FAIL"
+    # the DP re-deciding B under the same config -> FAIL (exact)
+    st, _ = _status(
+        _entry(pipeline="overlap", n_buckets=5, overlap_frac=0.6),
+        "n_buckets")
+    assert st == "FAIL"
+    # new instrumentation on the current side is not a regression
+    rows, fails = obs_registry.regress(
+        _entry(pipeline="overlap"), _entry())
+    assert {r[0]: r[4] for r in rows}["pipeline"] == "new"
+    assert fails == 0
+    # serial baseline 0.0 bounds a mildly-overlapped current run
+    rows, fails = obs_registry.regress(
+        _entry(overlap_frac=0.08), _entry(overlap_frac=0.0))
+    assert {r[0]: r[4] for r in rows}["overlap_frac"] == "ok"
+    assert fails == 0
 
 
 def test_registry_append_history_and_torn_lines(tmp_path, capsys):

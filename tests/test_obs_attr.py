@@ -22,6 +22,8 @@ from gtopkssgd_tpu.obs.timeline import (
     validate_timeline,
 )
 from gtopkssgd_tpu.obs.trace_attr import (
+    _interval_union,
+    _intersection_us,
     attribute,
     classify_op,
     classify_span,
@@ -29,6 +31,7 @@ from gtopkssgd_tpu.obs.trace_attr import (
     format_attr,
     host_span_means,
     op_ranking,
+    overlap_fraction,
     self_durations_us,
 )
 
@@ -195,6 +198,92 @@ def test_attribute_falls_back_to_ops_on_thin_span_coverage():
     assert rec["source"] == "ops"
     assert rec["frac_select"] == pytest.approx(0.3)
     assert rec["frac_comm"] == pytest.approx(0.2)
+
+
+def test_attribute_mixes_sources_per_class():
+    # Only the comm scope propagated onto the device lanes: its span
+    # (15µs) covers ≥ half of comm's op time (20µs), while compute and
+    # select have no spans at all. The per-class choice keeps span truth
+    # for comm and the op classifier for the rest — before PR 15 the
+    # thin global coverage dragged ALL three onto ops.
+    trace = _synthetic_trace(
+        span_us=[("train/step/comm", 15.0)],
+        op_us=[("fusion.1", 50.0), ("sort.1", 30.0), ("all-reduce.1", 20.0)])
+    rec = attribute(trace)
+    assert rec["source"] == "mixed"
+    assert rec["source_comm"] == "spans"
+    assert rec["source_compute"] == "ops"
+    assert rec["source_select"] == "ops"
+    assert rec["t_comm_us"] == pytest.approx(15.0)
+    assert rec["t_compute_us"] == pytest.approx(50.0)
+    assert rec["t_select_us"] == pytest.approx(30.0)
+    # the report table prints the per-class pick, not just the label
+    table = format_attr(rec)
+    assert "source=mixed" in table
+    assert "spans" in table and "ops" in table
+
+
+def test_attribute_thin_span_class_falls_to_ops():
+    # A comm span UNDER the coverage floor (5 < 0.5 * 20) must not win:
+    # every class lands on ops and the label stays "ops", not "mixed".
+    trace = _synthetic_trace(
+        span_us=[("train/step/comm", 5.0)],
+        op_us=[("fusion.1", 50.0), ("sort.1", 30.0), ("all-reduce.1", 20.0)])
+    rec = attribute(trace)
+    assert rec["source"] == "ops"
+    assert rec["source_comm"] == "ops"
+    assert rec["t_comm_us"] == pytest.approx(20.0)
+
+
+# ---------------------------------------------------- overlap measurement
+
+def test_interval_union_merges_and_drops_degenerate():
+    assert _interval_union([]) == []
+    assert _interval_union([(5.0, 5.0), (3.0, 1.0)]) == []   # degenerate
+    assert _interval_union([(0.0, 2.0), (1.0, 3.0), (3.0, 4.0),
+                            (10.0, 11.0)]) == [(0.0, 4.0), (10.0, 11.0)]
+
+
+def test_intersection_of_disjoint_unions():
+    a = [(0.0, 10.0), (20.0, 30.0)]
+    b = [(5.0, 25.0), (29.0, 40.0)]
+    # [5,10) + [20,25) + [29,30)
+    assert _intersection_us(a, b) == pytest.approx(11.0)
+    assert _intersection_us(a, []) == 0.0
+
+
+def test_overlap_fraction_bounds():
+    assert overlap_fraction([], [(0.0, 5.0)]) == 0.0           # no comm
+    assert overlap_fraction([(0.0, 4.0)], []) == 0.0           # no other
+    assert overlap_fraction([(0.0, 4.0)], [(0.0, 4.0)]) == 1.0  # hidden
+    assert overlap_fraction([(0.0, 4.0)], [(2.0, 6.0)]) == 0.5
+
+
+def _two_lane_op_trace(lane1, lane2):
+    """Two executor op lanes (args.hlo_op marks op events) so comm on one
+    lane can be wall-clock concurrent with compute on the other."""
+    events = []
+    for tid, ops in ((1, lane1), (2, lane2)):
+        for name, ts, dur in ops:
+            events.append(_ev(name, ts, dur, pid=3, tid=tid, hlo_op=name))
+    return {"traceEvents": events}
+
+
+def test_attribute_measures_cross_lane_comm_overlap():
+    # comm [0,100) on lane 1, compute [50,150) on lane 2: half the comm
+    # window is hidden under compute.
+    trace = _two_lane_op_trace(
+        [("all-reduce.1", 0.0, 100.0)],
+        [("fusion.1", 50.0, 100.0)])
+    rec = attribute(trace)
+    assert rec["overlap_frac"] == pytest.approx(0.5)
+    # a strictly serial schedule measures exactly zero
+    serial = _two_lane_op_trace(
+        [("all-reduce.1", 0.0, 100.0)],
+        [("fusion.1", 100.0, 100.0)])
+    assert attribute(serial)["overlap_frac"] == 0.0
+    # format_attr surfaces the measurement
+    assert "overlap_frac=0.5000" in format_attr(rec)
 
 
 # ------------------------------------------------------ timeline recorder

@@ -257,6 +257,52 @@ def test_planner_candidates_are_semantics_preserving():
                                             ici_size=4)] == ["hier"]
 
 
+def test_planner_carries_pipeline_and_span_columns():
+    """PR 15: the decision record carries the RESOLVED pipeline, and
+    every candidate row prices the step-span both execution orders would
+    expose — B>1 with nonzero select cost makes the overlapped span
+    strictly cheaper, B=1 makes them equal (nothing to overlap)."""
+    buckets = ((1_000_000, 1_000),) * 4
+    d = build_decision("gtopk_layerwise", p=8, n=4_000_000, k=4_000,
+                       alpha_ms=0.1, beta_gbps=0.6, bucketing="b4",
+                       buckets=buckets, pipeline="overlap")
+    assert d.plan.pipeline == "overlap"
+    rec = d.record()
+    assert rec["pipeline"] == "overlap"
+    for c in d.candidates:
+        assert c["span_serial_ms"] > 0
+        assert c["span_overlap_ms"] > 0
+        assert c["span_overlap_ms"] < c["span_serial_ms"], c["name"]
+    # the schedule choice itself stays a comm_ms decision; the spans are
+    # evidence, recorded per candidate
+    assert {c["name"] for c in d.candidates} == {"tree", "balanced"}
+    # an unbucketed wire is one bucket of the full (n, k): both orders
+    # expose the same span, and the default pipeline is serial
+    d1 = build_decision("gtopk", p=8, n=4_000_000, k=4_000,
+                        alpha_ms=0.1, beta_gbps=0.6)
+    assert d1.plan.pipeline == "serial"
+    assert d1.record()["pipeline"] == "serial"
+    for c in d1.candidates:
+        assert c["span_overlap_ms"] == pytest.approx(c["span_serial_ms"])
+    # pipeline rides only the gtopk-family candidates — a dense wire has
+    # no select/merge chain to reorder
+    (dense,) = candidate_plans("dense", pipeline="overlap")
+    assert dense.pipeline == "serial"
+
+
+def test_resolve_plan_memo_keys_on_pipeline():
+    buckets = ((5_000, 50), (5_000, 50))
+    a = resolve_plan("gtopk_layerwise", 8, 10_000, 100, "fp32", 1,
+                     "auto", None, "b2", buckets, "serial")
+    b = resolve_plan("gtopk_layerwise", 8, 10_000, 100, "fp32", 1,
+                     "auto", None, "b2", buckets, "overlap")
+    assert a is not b
+    assert a.pipeline == "serial" and b.pipeline == "overlap"
+    assert a.schedule == b.schedule            # order, not wire choice
+    assert resolve_plan("gtopk_layerwise", 8, 10_000, 100, "fp32", 1,
+                        "auto", None, "b2", buckets, "serial") is a
+
+
 def test_resolve_plan_memoizes():
     a = resolve_plan("gtopk", 8, 10_000, 100)
     b = resolve_plan("gtopk", 8, 10_000, 100)
