@@ -785,6 +785,7 @@ def run_smoke(out_dir: str) -> str:
     overlap_rec = run_overlap_smoke(out_dir)
     calib_rec = run_calib_smoke(out_dir)
     mem_rec = run_mem_smoke(out_dir)
+    critpath_rec, critpath_real = run_critpath_smoke(out_dir)
 
     cfg = smoke_config(out_dir)
     with Trainer(cfg) as t:
@@ -859,6 +860,16 @@ def run_smoke(out_dir: str) -> str:
         # storm chain on the chaos leg (reshape -> retrace -> exactly
         # one recompile -> exit 44).
         t.metrics.log("mem", **mem_rec)
+        # And the critical-path smoke: one REAL per-step stage-interval
+        # record from the overlap arm (so the registry's wait_frac /
+        # crit_stage_modal path runs on gate data) plus the summary the
+        # baseline pins — the >=90%-coverage floor breach (exact), the
+        # synthetic skewed arm's wait share, and the clean/skewed
+        # critpath_shift firing counts with the exit-44 halt contract.
+        # Durable evidence -> flush=True on both.
+        if critpath_real is not None:
+            t.metrics.log("critpath", flush=True, **critpath_real)
+        t.metrics.log("critpath", flush=True, **critpath_rec)
         # Static-analysis gate: run graftlint in-process over the
         # package + benchmarks against the committed repo baseline and
         # record the counts; the gate pins non_baselined at exactly 0,
@@ -866,6 +877,115 @@ def run_smoke(out_dir: str) -> str:
         # numeric regression.
         t.metrics.log("lint", **run_lint_smoke())
     return out_dir
+
+
+def run_critpath_smoke(out_dir: str) -> tuple:
+    """Distributed-critical-path smoke (the critpath tentpole's
+    consumer): two tiny p=2 arms differing ONLY in --pipeline (serial
+    vs overlap), each with --obs-critpath at every-step cadence so the
+    trainer's own capture gate logs durable per-step stage-interval
+    records, plus a deterministic synthetic skewed/clean pair for the
+    fields real timing can't pin. Returns (summary_record,
+    real_record): the summary the gate pins and one real per-step
+    record from the overlap arm grafted into the main stream (so the
+    registry's wait_frac/crit_stage_modal path runs on gate data).
+
+      crit_frac                min over every logged record of the
+                               single-rank chain walk's coverage of
+                               that record's measured step wall —
+                               gap-filled attribution must explain
+                               the whole captured dispatch
+      crit_frac_floor_breach   max(0, 0.90 - crit_frac): the PR's
+                               >=90%-coverage acceptance pin, exact
+      n_records                total critpath records across both
+                               arms (2 steps x 2 arms)
+      wait_frac_skewed         synthetic barrier-stall rank record
+                               (fixture geometry): exactly 0.8
+      crit_stage_skewed_wait   1.0 iff the joined 2-rank skewed step's
+                               global critical stage is "wait"
+      shift_events_clean       critpath_shift firings on a 6-step
+                               constant-stage stream: exactly 0
+      shift_events_skewed      firings on compute x3 -> wait x3 at
+                               the default 3-window threshold:
+                               exactly 1
+      halt_exit_ok             1.0 iff halt_on="warn" raises
+                               AnomalyHalt on that shift and the
+                               halt exit code contract is 44
+    """
+    from gtopkssgd_tpu.obs import critpath, report
+    from gtopkssgd_tpu.obs.events import (AnomalyHalt, AnomalyMonitor,
+                                          HALT_EXIT_CODE, Thresholds)
+    from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+    fracs = {}
+    n_records = 0
+    real_rec = None
+    for pipe in ("serial", "overlap"):
+        sub = os.path.join(out_dir, f"critpath_{pipe}")
+        cfg = TrainConfig(
+            dnn="resnet20", batch_size=4, nworkers=2,
+            compression="gtopk_layerwise", density=0.01, seed=42,
+            max_epochs=1, log_interval=2, eval_batches=1,
+            obs_interval=1, wire_codec="fp32", buckets="4",
+            pipeline=pipe, out_dir=sub,
+            obs_critpath=True, obs_calib_interval=1)
+        with Trainer(cfg) as t:
+            t.train(2)
+        recs, _ = report.load_records(sub)
+        cps = [r for r in recs if r.get("kind") == "critpath"]
+        n_records += len(cps)
+        arm_fracs = []
+        for cp in cps:
+            res = critpath.critical_path({0: cp["segments"]})
+            arm_fracs.append(res["crit_frac"])
+        fracs[pipe] = min(arm_fracs) if arm_fracs else 0.0
+        if pipe == "overlap" and cps:
+            real_rec = {k: v for k, v in cps[-1].items()
+                        if k not in ("kind", "time", "rank")}
+    crit_frac = min(fracs.values()) if fracs else 0.0
+
+    # ---- deterministic synthetic pair (fixture geometry): real CPU
+    # timing can't pin wait shares or shift counts, hand-built segment
+    # sets can, and they run the SAME join/rule code paths.
+    stalled = [{"stage": "compute", "t0_us": 0.0, "t1_us": 100.0},
+               {"stage": "wait", "t0_us": 100.0, "t1_us": 900.0},
+               {"stage": "comm", "t0_us": 900.0, "t1_us": 1000.0}]
+    skew_rec = critpath.build_record(stalled)
+    joined = critpath.critical_path({0: list(stalled), 1: list(stalled)})
+
+    clean_mon = AnomalyMonitor()
+    for step in range(1, 7):
+        clean_mon.observe_critpath(step, crit_stage="compute")
+    shift_clean = sum(e["rule"] == "critpath_shift"
+                      for e in clean_mon.events)
+    skew_mon = AnomalyMonitor(
+        thresholds=Thresholds(critpath_shift_windows=3))
+    for step, stage in enumerate(["compute"] * 3 + ["wait"] * 3, 1):
+        skew_mon.observe_critpath(step, crit_stage=stage)
+    shift_skew = sum(e["rule"] == "critpath_shift"
+                     for e in skew_mon.events)
+    halt_ok = 0.0
+    halt_mon = AnomalyMonitor(
+        thresholds=Thresholds(critpath_shift_windows=3), halt_on="warn")
+    try:
+        for step, stage in enumerate(["compute"] * 3 + ["wait"] * 3, 1):
+            halt_mon.observe_critpath(step, crit_stage=stage)
+    except AnomalyHalt:
+        halt_ok = float(HALT_EXIT_CODE == 44)
+
+    summary = {
+        "n_records": float(n_records),
+        "crit_frac": round(float(crit_frac), 6),
+        "crit_frac_serial": round(float(fracs.get("serial", 0.0)), 6),
+        "crit_frac_overlap": round(float(fracs.get("overlap", 0.0)), 6),
+        "crit_frac_floor_breach": round(max(0.0, 0.90 - crit_frac), 6),
+        "wait_frac_skewed": skew_rec["wait_frac"],
+        "crit_stage_skewed_wait": float(joined["crit_stage"] == "wait"),
+        "shift_events_clean": float(shift_clean),
+        "shift_events_skewed": float(shift_skew),
+        "halt_exit_ok": halt_ok,
+    }
+    return summary, real_rec
 
 
 def run_lint_smoke() -> dict:

@@ -498,15 +498,17 @@ class CodecWireRule:
 class DurableEventRule:
     """Records that exist to survive a hard kill — anomaly ``event``s,
     injected-fault ``inject`` firings, ``recovery`` actions, comm-model
-    ``calib`` refits, ``regress`` and ``overlap`` evidence rows — must
-    be fsync'd at the call site: ``.log(kind, flush=True, ...)``. Line
-    buffering alone only reaches the OS, and these kinds are exactly
-    the ones read back after a crash."""
+    ``calib`` refits, ``regress``/``overlap`` evidence rows, and
+    ``critpath`` stage-interval records (the post-mortem "which stage
+    bounded the last step" evidence) — must be fsync'd at the call
+    site: ``.log(kind, flush=True, ...)``. Line buffering alone only
+    reaches the OS, and these kinds are exactly the ones read back
+    after a crash."""
 
     name = "durable-event"
 
     DURABLE_KINDS = {"event", "inject", "recovery", "calib", "regress",
-                     "compile", "overlap"}
+                     "compile", "overlap", "critpath"}
 
     def run(self, files: Sequence[SourceFile]) -> List[Finding]:
         findings: List[Finding] = []

@@ -177,6 +177,26 @@ reference's only telemetry was text logs):
                                          each sample costs a capture)
     --obs-calib-interval N               steps between calibration
                                          captures (default 25)
+    --obs-critpath / --no-obs-critpath   per-step stage-interval records
+                                         (obs.critpath): profile-attribute
+                                         a dispatch every
+                                         --obs-calib-interval steps
+                                         (shares the calibrator's capture
+                                         when both are on) into ordered
+                                         {stage, t0, t1} segments with
+                                         the comm span split into wire
+                                         vs skew-wait by the ledger's
+                                         alpha-beta model; one durable
+                                         'critpath' record per sample,
+                                         joined across ranks by
+                                         `report critpath` into the
+                                         global critical path
+                                         (default off)
+    --obs-critpath-shift-windows K       consecutive joined steps whose
+                                         critical stage differs from the
+                                         established modal stage before
+                                         the critpath_shift anomaly
+                                         fires (default 3)
     --obs-mem / --no-obs-mem             compile/memory-plane watch
                                          (obs.memwatch): AOT compile
                                          accounting — one fsync'd
@@ -451,6 +471,21 @@ def build_argparser() -> argparse.ArgumentParser:
                         "each sample costs a profiler capture + sync")
     p.add_argument("--obs-calib-interval", type=int, default=25,
                    help="optimizer steps between calibration captures")
+    p.add_argument("--obs-critpath",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="per-step stage-interval records (obs.critpath): "
+                        "every --obs-calib-interval steps, "
+                        "profile-attribute one dispatch into ordered "
+                        "{stage, t0, t1} segments, splitting the comm "
+                        "span into wire vs skew-wait via the ledger's "
+                        "alpha-beta model, and log a durable 'critpath' "
+                        "record; `report critpath` joins the per-rank "
+                        "records into the global critical path. Opt-in: "
+                        "each sample costs a profiler capture + sync")
+    p.add_argument("--obs-critpath-shift-windows", type=int, default=3,
+                   help="consecutive joined steps whose critical stage "
+                        "differs from the established modal stage "
+                        "before the critpath_shift anomaly fires")
     p.add_argument("--obs-mem", action=argparse.BooleanOptionalAction,
                    default=False,
                    help="compile/memory-plane watch (obs.memwatch): AOT "
@@ -578,6 +613,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         obs_export_port=args.obs_export_port,
         obs_calib=args.obs_calib,
         obs_calib_interval=args.obs_calib_interval,
+        obs_critpath=args.obs_critpath,
+        obs_critpath_shift_windows=args.obs_critpath_shift_windows,
         obs_mem=args.obs_mem,
         obs_mem_interval=args.obs_mem_interval,
         obs_recompile_warmup=args.obs_recompile_warmup,

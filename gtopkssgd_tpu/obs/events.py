@@ -59,6 +59,17 @@ synced (no extra device reads):
                                   (fires on the crossing; re-arms when
                                   usage drops back under). Same feed as
                                   device_mem_leak
+  critpath_shift        warn      the fleet's global critical stage
+                                  (obs/critpath.py via the fleet join)
+                                  differed from the established modal
+                                  stage for ``critpath_shift_windows``
+                                  CONSECUTIVE joined steps — the step's
+                                  bottleneck moved (e.g. compute→wait:
+                                  a peer started skewing the
+                                  collective). Fires once per shift,
+                                  then adopts the new stage as modal
+                                  and re-arms. Fed by the fleet merger
+                                  through ``observe_critpath``
 
 Each firing emits one severity-tagged ``event`` record through
 MetricsLogger with ``flush=True`` (fsync'd — a run killed one line later
@@ -120,6 +131,10 @@ class Thresholds:
                                      # windows before device_mem_leak
     hbm_headroom_frac: float = 0.92  # bytes_in_use / bytes_limit above
                                      # which hbm_headroom fires
+    critpath_shift_windows: int = 3  # consecutive joined steps whose
+                                     # global critical stage differs
+                                     # from the modal one before
+                                     # critpath_shift fires
 
     def age_max(self, rho: Optional[float]) -> float:
         if self.residual_age_max > 0:
@@ -196,6 +211,14 @@ class AnomalyMonitor:
         self._mem_grow = 0
         self._mem_leak_fired = False
         self._headroom_over = False
+        # Critical-path state (observe_critpath): the established modal
+        # critical stage, plus the current differing streak and the
+        # stage it has settled on. The first observation sets the modal
+        # stage (inherent warmup — nothing can fire before a modal
+        # stage exists to shift FROM).
+        self._crit_modal: Optional[str] = None
+        self._crit_streak = 0
+        self._crit_streak_stage: Optional[str] = None
 
     # ---------------------------------------------------------- the rules
     def _check(self, step: int, loss: Optional[float],
@@ -407,6 +430,48 @@ class AnomalyMonitor:
                 self._headroom_over = False
         return out
 
+    # ------------------------------------------- critical path (fleet)
+    def _check_critpath(self, step: int, crit_stage: Optional[str]
+                        ) -> List[Dict[str, Any]]:
+        th = self.th
+        out: List[Dict[str, Any]] = []
+        if not crit_stage:
+            return out
+        if self._crit_modal is None:
+            # Inherent warmup: the first joined step ESTABLISHES the
+            # modal stage; there is nothing to shift from yet.
+            self._crit_modal = crit_stage
+            return out
+        if crit_stage == self._crit_modal:
+            self._crit_streak = 0
+            self._crit_streak_stage = None
+            return out
+        # Differing stage: extend the streak only while it stays on ONE
+        # new stage — a noisy alternation (comm, wait, comm, ...) is not
+        # a shift, it's churn, and restarts the count.
+        if crit_stage == self._crit_streak_stage:
+            self._crit_streak += 1
+        else:
+            self._crit_streak_stage = crit_stage
+            self._crit_streak = 1
+        if self._crit_streak >= th.critpath_shift_windows:
+            out.append({
+                "rule": "critpath_shift", "severity": "warn",
+                "step": step, "value": float(self._crit_streak),
+                "threshold": round(float(th.critpath_shift_windows), 6),
+                "from_stage": self._crit_modal, "to_stage": crit_stage,
+                "message": (f"global critical stage shifted "
+                            f"{self._crit_modal}->{crit_stage} for "
+                            f"{self._crit_streak} consecutive joined "
+                            "steps — the step's bottleneck moved"),
+            })
+            # Adopt the new stage and re-arm: the next shift is judged
+            # against what the fleet is NOW bounded by.
+            self._crit_modal = crit_stage
+            self._crit_streak = 0
+            self._crit_streak_stage = None
+        return out
+
     # ------------------------------------------------------------- public
     def _emit(self, fired: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         """Record, persist (fsync'd), mark on the timeline, and — after
@@ -489,6 +554,15 @@ class AnomalyMonitor:
         never arms there. Same emit/halt contract as observe."""
         return self._emit(self._check_memory(step, live_bytes,
                                              bytes_in_use, bytes_limit))
+
+    def observe_critpath(self, step: int, *,
+                         crit_stage: Optional[str] = None
+                         ) -> List[Dict[str, Any]]:
+        """Evaluate the critpath_shift rule against one fleet-joined
+        step's global critical stage (obs/critpath.py critical_path via
+        the fleet merger). Same emit/halt contract as observe — a moved
+        bottleneck trips --obs-halt-on warn like any other anomaly."""
+        return self._emit(self._check_critpath(step, crit_stage))
 
     def summary(self) -> Dict[str, int]:
         """{rule: count} over the monitor's lifetime (test/report aid)."""

@@ -27,10 +27,20 @@ Pieces (all host-side, stdlib-only — report-CLI friendly):
       persistent-vs-transient classification via a per-rank EWMA of lag,
       fed through AnomalyMonitor.observe_ranks so the
       ``straggler_persistent`` rule emits ordinary ``event`` records and
-      ``--obs-halt-on`` covers it.
+      ``--obs-halt-on`` covers it. Rows carry the slowest rank's local
+      critical ``stage`` when that rank shipped critpath records — the
+      difference between "rank 2 is late" and "rank 2 is late because
+      its input pipeline (compute) is slow".
+  critpath_rows              join per-rank ``critpath`` stage-interval
+      records (obs/critpath.py) by step into the GLOBAL critical path:
+      per-step crit_rank/crit_stage/crit_frac + the (rank, stage) chain,
+      per-rank on-chain stage budgets and blocked-time totals, fed
+      through AnomalyMonitor.observe_critpath so the ``critpath_shift``
+      rule emits ordinary ``event`` records and ``--obs-halt-on``
+      covers a moved bottleneck.
   merge                      the one-call entry (report ``fleet``
       subcommand, gate smoke): shards in, rows + straggler attribution +
-      fired events + the validated manifest out.
+      critical-path join + fired events + the validated manifest out.
 
 Ragged shards are first-class: a rank missing a step (crashed, still
 catching up, thinned logging) drops out of that step's stats — ``n_ranks``
@@ -43,6 +53,7 @@ import math
 import os
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
+from gtopkssgd_tpu.obs import critpath as _critpath
 from gtopkssgd_tpu.obs.events import AnomalyMonitor
 from gtopkssgd_tpu.obs.report import extract_manifest, load_records
 from gtopkssgd_tpu.utils.metrics import shard_filename, shard_rank
@@ -255,6 +266,10 @@ def straggler_rows(records_by_rank: Mapping[int, List[dict]],
     kind = kind or pick_straggler_kind(records_by_rank)
     if kind is None:
         return [], []
+    # The slowest rank's LOCAL critical stage (from its critpath record
+    # at that step, when it shipped one): why that host was late, not
+    # just that it was.
+    crit_idx = _index_by_step(records_by_rank, ("critpath",))
     by_step = _arrival_times(records_by_rank, kind)
     steps = sorted(by_step)
     med_arrivals = [_median(list(by_step[s].values())) for s in steps]
@@ -273,6 +288,7 @@ def straggler_rows(records_by_rank: Mapping[int, List[dict]],
         events_before = len(monitor.events)
         monitor.observe_ranks(step, lags, step_dur=step_dur)
         fired = monitor.events[events_before:]
+        crec = crit_idx.get(("critpath", step), {}).get(slowest) or {}
         rows.append({
             "src": kind, "step": step, "field": "straggler",
             "n_ranks": len(times),
@@ -282,8 +298,61 @@ def straggler_rows(records_by_rank: Mapping[int, List[dict]],
             "ewma_lag_s": monitor.rank_lag_ewma.get(slowest, 0.0),
             "persistent": any(ev["rule"] == "straggler_persistent"
                               for ev in fired),
+            "stage": crec.get("crit_stage"),
         })
     return rows, list(monitor.events)
+
+
+def critpath_rows(records_by_rank: Mapping[int, List[dict]],
+                  monitor: Optional[AnomalyMonitor] = None
+                  ) -> Tuple[List[dict], Dict[int, Dict[str, float]]]:
+    """The global critical path: join per-rank ``critpath`` stage-
+    interval records by step and run obs/critpath.py's deterministic
+    chain walk over each step's segment sets.
+
+    Returns (rows, budgets). Each row: the step's crit_rank/crit_stage,
+    ``crit_frac`` (how much of the step wall the chain explains), the
+    (rank, stage) chain itself, and per-rank blocked (wait) time.
+    ``budgets`` accumulates across steps: per rank, µs ON the chain by
+    stage plus total ``blocked_us`` — the eviction-decision view (which
+    host binds the fleet, and with which stage). ``monitor`` carries the
+    ``critpath_shift`` modal-stage state; pass the trainer's monitor
+    (halt_on set) to make a moved bottleneck fail fast."""
+    idx = _index_by_step(records_by_rank, ("critpath",))
+    monitor = monitor or AnomalyMonitor()
+    rows: List[dict] = []
+    budgets: Dict[int, Dict[str, float]] = {}
+    for (_, step), per_rank in sorted(idx.items()):
+        segs_by_rank = {
+            r: rec.get("segments") or [] for r, rec in per_rank.items()}
+        res = _critpath.critical_path(segs_by_rank)
+        events_before = len(monitor.events)
+        monitor.observe_critpath(step, crit_stage=res["crit_stage"])
+        fired = monitor.events[events_before:]
+        rows.append({
+            "src": "critpath", "step": step, "field": "critpath",
+            "n_ranks": len(per_rank),
+            "crit_rank": res["crit_rank"],
+            "crit_stage": res["crit_stage"],
+            "crit_frac": res["crit_frac"],
+            "wall_us": res["wall_us"],
+            "chain": res["chain"],
+            "stage_us": res["stage_us"],
+            "blocked_us": {f"r{r}": us
+                           for r, us in res["blocked_us"].items()},
+            "shift": any(ev["rule"] == "critpath_shift" for ev in fired),
+        })
+        for p in res["chain"]:
+            b = budgets.setdefault(
+                p["rank"], {s: 0.0 for s in _critpath.STAGES})
+            b[p["stage"]] += p["t1_us"] - p["t0_us"]
+        for r, us in res["blocked_us"].items():
+            b = budgets.setdefault(r, {s: 0.0 for s in _critpath.STAGES})
+            b["blocked_us"] = b.get("blocked_us", 0.0) + us
+    for b in budgets.values():
+        for key in list(b):
+            b[key] = round(b[key], 1)
+    return rows, budgets
 
 
 def merge(targets: Sequence[str],
@@ -292,17 +361,23 @@ def merge(targets: Sequence[str],
           monitor: Optional[AnomalyMonitor] = None,
           allow_mismatch: bool = False) -> Dict[str, Any]:
     """One-call fleet merge: resolve + load + validate shards, build the
-    merged stat rows and the straggler attribution. Raises on unreadable
-    targets, duplicate ranks, and config_hash mismatch (see
-    validate_shards); AnomalyHalt propagates when ``monitor`` has
-    ``halt_on`` set and a persistent straggler fires."""
+    merged stat rows, the straggler attribution and the critical-path
+    join. Raises on unreadable targets, duplicate ranks, and config_hash
+    mismatch (see validate_shards); AnomalyHalt propagates when
+    ``monitor`` has ``halt_on`` set and a persistent straggler (or a
+    critical-stage shift) fires."""
     shards = resolve_targets(targets)
     records_by_rank, bad = load_shards(shards)
     manifest = validate_shards(records_by_rank,
                                allow_mismatch=allow_mismatch)
     rows = fleet_rows(records_by_rank, kinds=kinds)
-    stragglers, events = straggler_rows(
+    # One monitor carries both rules' state so merge()'s events list is
+    # the single ordered stream --obs-halt-on acts on.
+    monitor = monitor or AnomalyMonitor()
+    stragglers, _ = straggler_rows(
         records_by_rank, kind=straggler_kind, monitor=monitor)
+    crit_rows, crit_budget = critpath_rows(records_by_rank,
+                                           monitor=monitor)
     return {
         "shards": {r: shards[r] for r in sorted(shards)},
         "ranks": sorted(shards),
@@ -310,7 +385,9 @@ def merge(targets: Sequence[str],
         "manifest": manifest,
         "rows": rows,
         "stragglers": stragglers,
-        "events": events,
+        "critpath": crit_rows,
+        "critpath_budget": crit_budget,
+        "events": list(monitor.events),
     }
 
 

@@ -67,13 +67,21 @@ REGRESS_CHECKS: Tuple[Tuple[str, float, float], ...] = (
     ("recompile_count", 0.0, 0.0),
     ("overlap_frac", 0.0, 0.10),
     ("n_buckets", 0.0, 0.0),
+    # wait_frac (mean share of each rank's step wall spent blocked at
+    # collectives, from the critpath plane) gets the same purely
+    # absolute 0.1 slack as overlap_frac and for the same reason: it
+    # lives in [0, 1] and a clean baseline of 0.0 must still bound a
+    # current run that started skewing.
+    ("wait_frac", 0.0, 0.10),
 )
 
 # String-valued stats checked for EXACT equality (the numeric loop's
 # finiteness gate would silently skip them — a chosen pipeline that
 # flips serial<->overlap under the same config is a plan regression,
-# not noise).
-REGRESS_EXACT_STR: Tuple[str, ...] = ("pipeline",)
+# not noise; the modal critical stage moving compute<->wait under the
+# same config means the run's bottleneck moved, which is exactly what
+# the critpath plane exists to flag).
+REGRESS_EXACT_STR: Tuple[str, ...] = ("pipeline", "crit_stage_modal")
 
 
 def _finite(x: Any) -> bool:
@@ -109,6 +117,8 @@ def run_summary(records: Sequence[Dict[str, Any]]
     wire_sum, wire_n = 0.0, 0
     ratio_sum, ratio_n = 0.0, 0
     ofrac_sum, ofrac_n = 0.0, 0
+    wait_sum, wait_n = 0.0, 0
+    crit_counts: Dict[str, int] = {}
     saw_memwatch = False
     recompile_count = 0
     for rec in records:
@@ -147,6 +157,17 @@ def run_summary(records: Sequence[Dict[str, Any]]
             if _finite(rec.get("overlap_frac")):
                 ofrac_sum += float(rec["overlap_frac"])
                 ofrac_n += 1
+        elif kind == "critpath":
+            # per-rank stage-interval plane (obs/critpath.py): the mean
+            # blocked share and the modal LOCAL critical stage across
+            # all shipped records — cross-run comparable without the
+            # fleet join.
+            if _finite(rec.get("wait_frac")):
+                wait_sum += float(rec["wait_frac"])
+                wait_n += 1
+            cs = rec.get("crit_stage")
+            if isinstance(cs, str) and cs:
+                crit_counts[cs] = crit_counts.get(cs, 0) + 1
         elif kind == "recovery" and rec.get("final_status") is not None:
             final_status = rec.get("final_status")
     if manifest is None:
@@ -185,6 +206,16 @@ def run_summary(records: Sequence[Dict[str, Any]]
         stats["recompile_count"] = recompile_count
     if ofrac_n:
         stats["overlap_frac"] = round(ofrac_sum / ofrac_n, 6)
+    if wait_n:
+        stats["wait_frac"] = round(wait_sum / wait_n, 6)
+    if crit_counts:
+        # Modal stage; ties break by critpath.STAGES order (inlined as
+        # a sort over the fixed tuple to keep the registry stdlib-only).
+        order = ("compute", "select", "comm", "wait")
+        stats["crit_stage_modal"] = max(
+            sorted(crit_counts, key=lambda s: order.index(s)
+                   if s in order else len(order)),
+            key=lambda s: crit_counts[s])
     # Plan-shape stats: the chosen pipeline (plan record wins — it is
     # the decision as executed; the manifest stamp is the fallback for
     # runs without a planner) and the DP's bucket count, so regress can
@@ -267,6 +298,8 @@ def history_rows(entries: Sequence[Dict[str, Any]],
             str(stats.get("pipeline", "-")),
             _cell(stats.get("n_buckets")),
             _cell(stats.get("overlap_frac")),
+            str(stats.get("crit_stage_modal", "-")),
+            _cell(stats.get("wait_frac")),
             str(stats.get("final_status", "-")),
         ])
     return rows
@@ -275,7 +308,7 @@ def history_rows(entries: Sequence[Dict[str, Any]],
 HISTORY_HEADER = ["config", "git", "steps", "steps/s", "loss",
                   "comm_ratio", "alpha_ms", "beta_gbps", "recall",
                   "wireB/step", "peak_hbm", "recomp", "pipeline", "B",
-                  "ovl_frac", "status"]
+                  "ovl_frac", "crit_stage", "wait_frac", "status"]
 
 
 def pick_baseline(entry: Dict[str, Any],

@@ -13,6 +13,11 @@ or gate one against a committed baseline.
     python -m gtopkssgd_tpu.obs.report timeline <run>   # rebuild timeline.json
     python -m gtopkssgd_tpu.obs.report fleet <run>...   # cross-rank merge +
                                                         # straggler attribution
+    python -m gtopkssgd_tpu.obs.report critpath <run>...
+                                                        # global per-step
+                                                        # critical path: which
+                                                        # (rank, stage) bounds
+                                                        # each step, wait split
     python -m gtopkssgd_tpu.obs.report watch <run>...   # live tail-follow
     python -m gtopkssgd_tpu.obs.report ledger <run>...  # comm model vs measured
     python -m gtopkssgd_tpu.obs.report history <dir>    # registry trend table
@@ -713,12 +718,15 @@ def format_fleet(merged: dict, kinds: Optional[Sequence[str]] = None,
         st = [[_fmt(s["step"]), f"r{s['slowest_rank']}",
                _fmt(s["behind_median_s"]), _fmt(s["lag_s"]),
                _fmt(s["ewma_lag_s"]),
-               "persistent" if s["persistent"] else "transient"]
+               "persistent" if s["persistent"] else "transient",
+               str(s.get("stage") or "-")]
               for s in stragglers]
         chunks.append(f"\n[straggler] (src={stragglers[0]['src']}; lag = "
-                      "arrival behind first rank at each step's record)")
+                      "arrival behind first rank at each step's record; "
+                      "stage = the slowest rank's local critical stage)")
         chunks.append(_table(st, ["step", "slowest", "behind_median_s",
-                                  "lag_s", "ewma_lag_s", "class"]))
+                                  "lag_s", "ewma_lag_s", "class",
+                                  "stage"]))
         persistent = [s for s in stragglers if s["persistent"]]
         if persistent:
             worst = persistent[-1]
@@ -726,6 +734,21 @@ def format_fleet(merged: dict, kinds: Optional[Sequence[str]] = None,
                 f"persistent straggler: rank {worst['slowest_rank']} "
                 f"(EWMA lag {_fmt(worst['ewma_lag_s'])}s over "
                 f"{len(persistent)} flagged steps)")
+    crit = merged.get("critpath") or []
+    if crit:
+        counts: Dict[str, int] = {}
+        for r in crit:
+            st = r.get("crit_stage")
+            if st:
+                counts[st] = counts.get(st, 0) + 1
+        modal = (max(sorted(counts), key=lambda s: counts[s])
+                 if counts else None)
+        mean_frac = sum(float(r.get("crit_frac", 0.0))
+                        for r in crit) / len(crit)
+        chunks.append(f"\n[critpath] {len(crit)} joined step(s)  "
+                      f"modal critical stage: {modal}  "
+                      f"mean crit_frac={mean_frac:.4f}  "
+                      "(report critpath for the full chain)")
     events = merged.get("events") or []
     if events:
         by_rule: Dict[str, int] = {}
@@ -758,6 +781,70 @@ def run_fleet(targets: Sequence[str], kinds: Optional[Sequence[str]],
     if json_out:
         with open(json_out, "w") as fh:
             json.dump(merged, fh, indent=1, sort_keys=True, default=str)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0
+
+
+def run_critpath(targets: Sequence[str], json_out: Optional[str] = None,
+                 allow_mismatch: bool = False,
+                 halt_on: Optional[str] = None) -> int:
+    """``critpath`` subcommand: join per-rank ``critpath`` stage-interval
+    records (obs/critpath.py) across shards into the global per-step
+    critical path — which (rank, stage) chain bounds each step, how much
+    of T_comm was wire vs skew-wait, and where each rank's blocked time
+    went. ``halt_on`` arms the ``critpath_shift`` rule exactly like the
+    trainer's --obs-halt-on: a modal-stage shift exits HALT_EXIT_CODE
+    after its event row is printed."""
+    from gtopkssgd_tpu.obs import critpath as _critpath
+    from gtopkssgd_tpu.obs import fleet
+    from gtopkssgd_tpu.obs.events import (
+        HALT_EXIT_CODE,
+        AnomalyHalt,
+        AnomalyMonitor,
+    )
+
+    try:
+        shards = fleet.resolve_targets(list(targets))
+        records_by_rank, bad = fleet.load_shards(shards)
+        fleet.validate_shards(records_by_rank,
+                              allow_mismatch=allow_mismatch)
+    except (OSError, ValueError) as e:
+        print(f"cannot merge {list(targets)}: {e}")
+        return 2
+    if bad:
+        print(f"note: skipped {bad} malformed line(s)")
+    monitor = AnomalyMonitor(halt_on=halt_on)
+    try:
+        rows, budgets = fleet.critpath_rows(records_by_rank,
+                                            monitor=monitor)
+        halted = None
+    except AnomalyHalt as e:
+        halted = e.event
+        rows, budgets = [], {}
+    if halted is not None:
+        print(f"critpath: HALT on {halted['rule']} at step "
+              f"{halted.get('step')}: {halted.get('message')}")
+        return HALT_EXIT_CODE
+    if not rows:
+        print("critpath: no critpath records (run with --obs-critpath, "
+              "or the shards predate the stage-interval plane)")
+        return 1
+    print(f"critpath: ranks={sorted(records_by_rank)} "
+          f"steps={len(rows)}")
+    print(_critpath.format_critpath(rows, budgets))
+    events = list(monitor.events)
+    if events:
+        by_rule: Dict[str, int] = {}
+        for ev in events:
+            by_rule[ev["rule"]] = by_rule.get(ev["rule"], 0) + 1
+        print("\n[events] " + "  ".join(
+            f"{rule}={n}" for rule, n in sorted(by_rule.items())))
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump({"rows": rows, "budgets": budgets,
+                       "events": events}, fh, indent=1, sort_keys=True,
+                      default=str)
             fh.write("\n")
         print(f"wrote {json_out}")
     return 0
@@ -810,6 +897,25 @@ def run_watch(targets: Sequence[str], interval: float = 2.0,
                     st[4][str(rec.get("kind"))] = rec
             stamp = _time.strftime("%H:%M:%S")
             print(f"watch @ {stamp}  ({len(state)} rank(s))", file=out)
+            # Live straggler view: each rank's latest per-step record
+            # arrival vs the cross-rank median — the same
+            # behind_median_s the fleet straggler rows report, computed
+            # over whatever each shard has flushed so far.
+            arrivals: Dict[int, float] = {}
+            for rank in sorted(state):
+                last = state[rank][4]
+                for kind in ("train", "obs", "eval"):
+                    rec = last.get(kind)
+                    if rec is not None and isinstance(
+                            rec.get("time"), (int, float)):
+                        arrivals[rank] = float(rec["time"])
+                        break
+            med_arrival = None
+            if len(arrivals) >= 2:
+                vals = sorted(arrivals.values())
+                mid = len(vals) // 2
+                med_arrival = (vals[mid] if len(vals) % 2
+                               else 0.5 * (vals[mid - 1] + vals[mid]))
             for rank in sorted(state):
                 path, _, n, bad, last = state[rank]
                 latest = None
@@ -824,6 +930,15 @@ def run_watch(targets: Sequence[str], interval: float = 2.0,
                     for key in ("loss", "achieved_density", "wire_bytes"):
                         if isinstance(latest.get(key), (int, float)):
                             bits.append(f"{key}={_fmt(latest[key])}")
+                if med_arrival is not None and rank in arrivals:
+                    bits.append(
+                        "behind_median_s="
+                        f"{_fmt(arrivals[rank] - med_arrival)}")
+                cp = last.get("critpath")
+                if cp is not None and cp.get("crit_stage"):
+                    # this rank's local critical stage (latest critpath
+                    # record) — why it is slow, not just that it is.
+                    bits.append(f"crit_stage={cp['crit_stage']}")
                 mem = last.get("mem")
                 if mem is not None:
                     # space-plane gauges (--obs-mem): same fields the
@@ -1507,6 +1622,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                  if a.kinds else None)
         return run_fleet(a.targets, kinds, json_out=a.json_out,
                          allow_mismatch=a.allow_mismatch)
+    if argv and argv[0] == "critpath":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report critpath",
+            description="Join per-rank critpath stage-interval records "
+                        "into the global per-step critical path: which "
+                        "(rank, stage) bounds each step, per-rank "
+                        "stage/wait budgets, modal-path summary.")
+        ap.add_argument("targets", nargs="+",
+                        help="run dirs holding metrics.rank*.jsonl (or "
+                             "metrics.jsonl), or shard paths")
+        ap.add_argument("--json", dest="json_out", default=None)
+        ap.add_argument("--allow-mismatch", action="store_true",
+                        help="merge shards even when their manifest "
+                             "config_hash differs (normally refused)")
+        ap.add_argument("--halt-on", default=None,
+                        choices=("warn", "error"),
+                        help="exit HALT_EXIT_CODE when the "
+                             "critpath_shift rule fires at (or above) "
+                             "this severity, like --obs-halt-on")
+        a = ap.parse_args(argv[1:])
+        return run_critpath(a.targets, json_out=a.json_out,
+                            allow_mismatch=a.allow_mismatch,
+                            halt_on=a.halt_on)
     if argv and argv[0] == "watch":
         ap = argparse.ArgumentParser(
             "gtopkssgd_tpu.obs.report watch",

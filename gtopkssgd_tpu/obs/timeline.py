@@ -150,7 +150,14 @@ def timeline_from_records(records: List[dict],
     samples for train/obs numerics and instant markers for event/stall
     records, at their recorded wall-clock times. Span durations are not
     reconstructed (the jsonl carries window means, not start times) —
-    use --obs-timeline for the live span view."""
+    use --obs-timeline for the live span view.
+
+    ``critpath`` records (obs/critpath.py) additionally get per-rank
+    STAGE LANES: one duration event per stage segment on a dedicated
+    lane per rank, anchored so each rank's step window ends at its
+    record's wall-clock time, with ``args.critical`` marking the
+    segments the step's global (cross-rank) critical path runs
+    through — the Perfetto view of "which rank, which stage"."""
     events: List[dict] = [{
         "ph": "M", "name": "process_name", "pid": 0,
         "args": {"name": f"host {label} (from metrics.jsonl)"},
@@ -159,6 +166,58 @@ def timeline_from_records(records: List[dict],
         "args": {"name": "records"},
     }]
     body: List[dict] = []
+    # ---- critpath stage lanes: group records by step across ranks so
+    # the global chain can flag the critical segments.
+    crit_by_step: Dict[float, Dict[int, dict]] = {}
+    for rec in records:
+        if (rec.get("kind") == "critpath"
+                and isinstance(rec.get("step"), (int, float))
+                and isinstance(rec.get("time"), (int, float))
+                and isinstance(rec.get("segments"), list)):
+            crit_by_step.setdefault(
+                float(rec["step"]), {})[int(rec.get("rank", 0))] = rec
+    if crit_by_step:
+        # Lazy import: keeps the module's offline path stdlib-only for
+        # runs without a critpath plane.
+        from gtopkssgd_tpu.obs import critpath as _critpath
+        lanes_seen: set = set()
+        for step in sorted(crit_by_step):
+            per_rank = crit_by_step[step]
+            res = _critpath.critical_path(
+                {r: rec["segments"] for r, rec in per_rank.items()})
+            chain = res.get("chain", [])
+            for r in sorted(per_rank):
+                rec = per_rank[r]
+                tid = 100 + r  # one stage lane per rank, after tid 0
+                if tid not in lanes_seen:
+                    lanes_seen.add(tid)
+                    events.append({
+                        "ph": "M", "name": "thread_name", "pid": 0,
+                        "tid": tid,
+                        "args": {"name": f"critpath rank {r}"}})
+                # Anchor: the record lands when the step's capture
+                # ends, so the rank's window [0, wall] maps to
+                # [time - wall, time] on the shared wall-clock axis.
+                wall = float(rec.get("wall_us", 0.0))
+                t_end = float(rec["time"]) * 1e6
+                for seg in rec["segments"]:
+                    t0 = float(seg.get("t0_us", 0.0))
+                    t1 = float(seg.get("t1_us", 0.0))
+                    if t1 <= t0:
+                        continue
+                    critical = any(
+                        p["rank"] == r and p["stage"] == seg.get("stage")
+                        and min(float(p["t1_us"]), t1)
+                        - max(float(p["t0_us"]), t0) > 1e-6
+                        for p in chain)
+                    body.append({
+                        "ph": "X", "name": str(seg.get("stage")),
+                        "cat": "critpath",
+                        "ts": t_end - wall + t0, "dur": t1 - t0,
+                        "pid": 0, "tid": tid,
+                        "args": {"step": step, "critical": critical,
+                                 "crit_stage": res.get("crit_stage")},
+                    })
     for rec in records:
         kind = rec.get("kind")
         ts = rec.get("time")

@@ -261,7 +261,9 @@ def overlap_fraction(comm_iv: List[Tuple[float, float]],
 
 
 def attribute(trace, mode: Optional[str] = None,
-              min_span_coverage: float = 0.5) -> dict:
+              min_span_coverage: float = 0.5,
+              stage_intervals: bool = False,
+              wire_us: Optional[float] = None) -> dict:
     """The paper's decomposition from a chrome trace.
 
     ``trace`` is a capture dir, a trace file path, or an already-loaded
@@ -274,6 +276,14 @@ def attribute(trace, mode: Optional[str] = None,
     ``source_{term}``), the measured ``overlap_frac`` (see module
     docstring), op counts, and the top ops per bucket (strings; the
     report CLI prints them, aggregation ignores them).
+
+    ``stage_intervals=True`` additionally attaches ``rec["critpath"]``:
+    the compact per-step stage-interval record (obs/critpath.py) built
+    from the same per-class raw wall intervals the overlap measurement
+    uses, with the comm span wait-split against ``wire_us`` (the
+    ledger-modeled wire time for this step's bytes; None = no model =
+    the whole comm span stays ``comm``). Callers pop it and log it as
+    its own durable ``critpath`` record — it never rides the attr row.
     """
     trace_file = None
     if isinstance(trace, str):
@@ -363,7 +373,20 @@ def attribute(trace, mode: Optional[str] = None,
     ofrac = overlap_fraction(
         iv["comm"], [x for t in TERMS if t != "comm" for x in iv[t]])
 
+    rec = {}
+    if stage_intervals:
+        # Lazy import: critpath imports this module at module level for
+        # the interval algebra; the reverse edge stays call-time only.
+        from gtopkssgd_tpu.obs import critpath
+        budget = float("inf") if wire_us is None else float(wire_us)
+        fine = critpath.stage_segments(iv, budget, fill_gaps=True)
+        # Coarse segments for the chain/timeline (compact durable
+        # record); exact per-stage totals from the fine list.
+        rec["critpath"] = critpath.build_record(
+            critpath.coarsen(fine, min_us=500.0),
+            totals=critpath.stage_totals(fine))
     rec = {
+        **rec,
         "mode": mode,
         "source": source,
         "n_op_events": n_ops,

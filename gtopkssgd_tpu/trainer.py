@@ -271,6 +271,21 @@ class TrainConfig:
                                    # nworkers > 1; off by default — each
                                    # measurement is a profiler capture
     obs_calib_interval: int = 25   # steps between calibration captures
+    obs_critpath: bool = False     # per-step stage-interval records
+                                   # (obs/critpath.py): profile-attribute
+                                   # a dispatch every obs_calib_interval
+                                   # steps (shares the calibrator's
+                                   # capture when both are on) and log a
+                                   # durable "critpath" record — ordered
+                                   # {stage, t0, t1} segments with the
+                                   # comm span wait-split against the
+                                   # ledger-modeled wire time — feeding
+                                   # the fleet's global critical-path
+                                   # join and the critpath_shift rule
+    obs_critpath_shift_windows: int = 3  # consecutive joined steps whose
+                                   # global critical stage differs from
+                                   # the modal one before critpath_shift
+                                   # fires (obs.events.Thresholds)
     registry: Optional[str] = None  # append this run's summary line to
                                    # DIR/runs.jsonl on exit
                                    # (obs/registry.py; read back with
@@ -430,7 +445,8 @@ class Trainer:
                 thresholds=Thresholds(
                     recompile_warmup=cfg.obs_recompile_warmup,
                     mem_leak_windows=cfg.obs_mem_leak_windows,
-                    hbm_headroom_frac=cfg.obs_hbm_headroom_frac),
+                    hbm_headroom_frac=cfg.obs_hbm_headroom_frac,
+                    critpath_shift_windows=cfg.obs_critpath_shift_windows),
                 timeline=self.timeline,
             )
             if cfg.obs_events else None
@@ -766,6 +782,42 @@ class Trainer:
         self.calib.observe(step, wire_bytes=wire,
                            t_comm_ms=float(t_comm_us) / 1e3 / spd,
                            overlapped=overlapped)
+
+    def _log_critpath(self, step: int, spd: int, trace_dir: str,
+                      cleanup: bool = True) -> None:
+        """Attribute the just-captured dispatch into ordered stage
+        intervals (obs/critpath.py) and log one durable "critpath"
+        record. The wire budget for the wait split comes from the
+        ledger's alpha-beta model priced on this run's manifest,
+        scaled by spd (the capture spans spd optimizer steps); when
+        the model can't parameterize, the whole comm span stays
+        "comm" and no wait is claimed. Feeds the local crit_stage to
+        the anomaly monitor (critpath_shift rule). ``cleanup=False``
+        leaves the trace dir for the calibrator feed that follows."""
+        import shutil
+
+        from gtopkssgd_tpu.obs import critpath
+        from gtopkssgd_tpu.obs.trace_attr import attribute
+        try:
+            w = critpath.modeled_wire_us(self._manifest, nprocs=self.p)
+            rec = attribute(trace_dir, mode=self.cfg.compression,
+                            stage_intervals=True,
+                            wire_us=None if w is None else w * spd)
+        except Exception as e:
+            self.logger.warning("critpath attribution failed: %s", e)
+            return
+        finally:
+            if cleanup:
+                shutil.rmtree(trace_dir, ignore_errors=True)
+        cp = rec.get("critpath")
+        if not cp:
+            return
+        self.metrics.log("critpath", flush=True, step=step, **cp)
+        # AnomalyHalt from the shift rule propagates like any monitor
+        # halt — the durable event record lands before the raise.
+        if self.monitor is not None:
+            self.monitor.observe_critpath(
+                step, crit_stage=cp.get("crit_stage"))
 
     def _make_tx(self, warmup_dense_steps: Optional[int] = None):
         """The optimizer transform; ``warmup_dense_steps`` overrides the
@@ -1447,11 +1499,18 @@ class Trainer:
                 calib_now = (
                     self.calib is not None and cfg.obs_calib_interval > 0
                     and (step + spd) % cfg.obs_calib_interval < spd)
+                # Critpath rides the SAME capture cadence (and the same
+                # captured trace, when both are on) — one profiled
+                # dispatch serves both consumers.
+                critpath_now = (
+                    cfg.obs_critpath and cfg.obs_calib_interval > 0
+                    and (step + spd) % cfg.obs_calib_interval < spd)
+                capture_now = calib_now or critpath_now
                 with self.tracer.span("dispatch"):
                     # Async enqueue only — the span must NOT drain the
                     # queue (the overlap is the point); device time shows
                     # under the same name in a profiler trace.
-                    if calib_now:
+                    if capture_now:
                         # Calibration sample: profile exactly this
                         # dispatch, blocking inside the capture so the
                         # device comm events land in the trace — a sync
@@ -1473,6 +1532,11 @@ class Trainer:
                 samples += (cfg.batch_size * cfg.nworkers
                             * cfg.nsteps_update * spd)
                 step += spd
+                if critpath_now:
+                    # Must run BEFORE the calibrator feed — that call
+                    # deletes the trace dir when it finishes.
+                    self._log_critpath(step, spd, trace_dir,
+                                       cleanup=not calib_now)
                 if calib_now:
                     self._feed_calibrator(step, spd, trace_dir)
                 if inj is not None:

@@ -84,6 +84,11 @@ KINDS = frozenset({
     "mem",         # sampled live-memory window (obs/memwatch.py):
                    # live_arrays count/bytes by dtype + per-device
                    # memory_stats where the backend exposes them
+    "critpath",    # per-step stage-interval record (obs/critpath.py):
+                   # ordered {stage, t0_us, t1_us} segments with the
+                   # comm span split into wire vs skew-wait by the
+                   # ledger's alpha-beta model; fleet joins these
+                   # across ranks into the global critical path
 })
 
 _SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")

@@ -1,0 +1,121 @@
+"""Regenerate the committed critpath fixture shards in this directory.
+
+Three rank shards of one synthetic run (shared config_hash), steps 1-6
+at a 1.0 s cadence, each step carrying a durable "critpath" record
+(ordered {stage, t0_us, t1_us} segments, obs/critpath.py) with the
+shapes the fleet joiner must handle baked in deterministically:
+
+  steps 1-3  compute-bound: rank 0 computes wall-to-wall
+             (compute [0,900] + comm [900,1000], zero wait) while
+             rank 1 is WAIT-dominated (wait 500 us of a 1000 us step)
+             and rank 2 sits between — the global chain runs entirely
+             through rank 0 and the critical stage is "compute".
+  steps 4-6  a barrier stall: EVERY rank is compute [0,100] +
+             wait [100,900] + comm [900,1000], so no rank has busy
+             work covering the middle of the step and the wait itself
+             joins the chain — the critical stage shifts to "wait".
+             Three consecutive shifted steps = exactly the default
+             critpath_shift_windows, so a monitor fed these shards
+             fires critpath_shift at step 6 (from compute to wait).
+  rank 2     arrives 2.5 s late at EVERY step (obs records) — a
+             persistent straggler, so straggler rows exist and carry
+             rank 2's LOCAL critical stage ("compute" then "wait").
+
+Values are hand-chosen, not sampled, so test assertions are exact:
+the expected global chain is hand-computable (see test_critpath.py).
+
+Run from anywhere:  python tests/fixtures/critpath/make_critpath_fixture.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+BASE_TIME = 1700000000.0
+STEP_S = 1.0          # wall-clock cadence of the synthetic run
+LAG_RANK = 2
+LAG_S = 2.5           # > 2.0 x STEP_S => persistent under the defaults
+CONFIG_HASH = "critfix0001beef"
+N_RANKS, STEPS = 3, (1, 2, 3, 4, 5, 6)
+NUM_PARAMS = 10000
+DENSITY = 0.01
+
+# Per-rank stage segments, µs inside the step window. Steps 1-3 use the
+# skewed layout; steps 4-6 the barrier-stall layout (same on all ranks).
+SKEWED = {
+    0: [("compute", 0.0, 900.0), ("comm", 900.0, 1000.0)],
+    1: [("compute", 0.0, 400.0), ("comm", 400.0, 500.0),
+        ("wait", 500.0, 1000.0)],
+    2: [("compute", 0.0, 600.0), ("comm", 600.0, 700.0),
+        ("wait", 700.0, 1000.0)],
+}
+STALLED = [("compute", 0.0, 100.0), ("wait", 100.0, 900.0),
+           ("comm", 900.0, 1000.0)]
+
+
+def manifest(rank: int) -> dict:
+    return {
+        "kind": "manifest", "time": BASE_TIME, "rank": rank,
+        "config_hash": CONFIG_HASH,
+        "dnn": "resnet20", "dataset": "cifar10",
+        "compression": "gtopk", "density": DENSITY,
+        "nworkers": N_RANKS, "batch_size": 4, "seed": 42,
+        "num_params": NUM_PARAMS,
+        "process_count": N_RANKS, "process_index": rank,
+        "coordinator_address": "127.0.0.1:9999",
+    }
+
+
+def obs_record(rank: int, step: int) -> dict:
+    lag = LAG_S if rank == LAG_RANK else 0.0
+    return {
+        "kind": "obs", "time": BASE_TIME + step * STEP_S + lag,
+        "rank": rank, "step": step,
+        "loss": round(2.0 - 0.1 * step + 0.01 * rank, 6),
+        "achieved_density": DENSITY,
+        "wire_bytes": 2400,
+    }
+
+
+def critpath_record(rank: int, step: int) -> dict:
+    """Mirror obs/critpath.py build_record arithmetic on the
+    hand-chosen segments (kept inline so the fixture regenerates
+    without importing the package)."""
+    layout = SKEWED[rank] if step <= 3 else STALLED
+    segs = [{"stage": s, "t0_us": a, "t1_us": b} for s, a, b in layout]
+    tot = {"compute": 0.0, "select": 0.0, "comm": 0.0, "wait": 0.0}
+    for s, a, b in layout:
+        tot[s] += b - a
+    wall = max(b for _, _, b in layout)
+    # Local dominant stage, ties broken in STAGES order.
+    order = ("compute", "select", "comm", "wait")
+    crit = max(order, key=lambda s: (tot[s], -order.index(s)))
+    lag = LAG_S if rank == LAG_RANK else 0.0
+    return {
+        "kind": "critpath", "time": BASE_TIME + step * STEP_S + lag,
+        "rank": rank, "step": step,
+        "wall_us": wall,
+        "t_compute_us": tot["compute"], "t_select_us": tot["select"],
+        "t_comm_wire_us": tot["comm"], "t_wait_us": tot["wait"],
+        "wait_frac": round(tot["wait"] / wall, 6),
+        "crit_stage": crit,
+        "segments": segs,
+    }
+
+
+def main() -> None:
+    for rank in range(N_RANKS):
+        path = os.path.join(HERE, f"metrics.rank{rank}.jsonl")
+        with open(path, "w") as fh:
+            fh.write(json.dumps(manifest(rank)) + "\n")
+            for step in STEPS:
+                fh.write(json.dumps(obs_record(rank, step)) + "\n")
+                fh.write(json.dumps(critpath_record(rank, step)) + "\n")
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

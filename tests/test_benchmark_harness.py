@@ -23,6 +23,10 @@ def test_measure_throughput_runs_every_bench_mode(mode, density):
     assert stats["steps_timed"] >= 1
 
 
+@pytest.mark.slow  # ~21 s: compiles two extra bench arms. The bench.py
+# compile/measure path for every mode stays tier-1 via
+# test_measure_throughput_runs_every_bench_mode; the dense x correction
+# ValueError guard itself is pinned in test_momentum_correction.
 def test_measure_throughput_momentum_correction_both_arms():
     """The corr queue stage measures BOTH arms from one cfg: the sparse
     arm gets the DGC recursion, the dense baseline arm must not trip

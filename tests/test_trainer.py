@@ -33,6 +33,10 @@ def test_single_worker_dense_loss_falls():
     assert "val_top5" in ev and ev["val_top5"] >= ev["val_top1"]
 
 
+@pytest.mark.slow  # ~62 s: 15 8-way steps on the serial box. The 8-way
+# SPMD mesh stays tier-1 via test_prefetch / test_hier / test_sharded_eval
+# (all nworkers=8) and the gtopk trainer path via the 2-way tests here;
+# multi-step loss behavior rides test_convergence.
 def test_spmd_gtopk_8way_trains():
     t = Trainer(small_cfg(
         nworkers=8, compression="gtopk", density=0.01, batch_size=4, lr=0.05,
@@ -51,6 +55,10 @@ def test_gradient_accumulation_steps():
     assert np.isfinite(stats["loss"])
 
 
+@pytest.mark.slow  # ~28 s: trains both arms 8 steps each. The spd guard
+# rails stay tier-1 (test_steps_per_dispatch_rejects_ragged_num_iters,
+# test_s2d_cli_flag_and_guard); bitwise spd-vs-per-step equivalence is
+# the slow-tier property this pins.
 def test_steps_per_dispatch_matches_per_step_path():
     """spd > 1 (lax.scan inside the dispatch) must train IDENTICALLY to
     the per-step path: same seed + same data stream -> same params. The
@@ -182,6 +190,10 @@ def test_per_dataset_defaults_resolve():
     assert cfg.dataset == "imagenet" and cfg.lr == 0.1
 
 
+@pytest.mark.slow  # ~60 s: one real ResNet-50 compile+step. The uint8
+# pipeline dtype contract stays tier-1 in tests/test_data.py and the
+# on-device normalization consumer in test_real_data's decode tests;
+# ResNet-50 shapes stay covered by test_models.
 def test_imagenet_uint8_wire_trains_one_step():
     """End-to-end through the uint8 wire format: the ImageNet pipeline
     ships raw pixels, the jitted step normalizes on device — one real
