@@ -21,11 +21,20 @@ m(k), recall) that may wobble with compiler version or thread count.
 Usage:
   python benchmarks/obs_gate_smoke.py                  # run + gate
   python benchmarks/obs_gate_smoke.py --write-baseline # regenerate
+  python benchmarks/obs_gate_smoke.py --only goodput   # one sub-smoke,
+                                       # gated against the SUBSET of the
+                                       # committed checks its kinds own
+  python benchmarks/obs_gate_smoke.py --only goodput --write-baseline
+                                       # re-stamp ONLY that subset's
+                                       # expectations back into the
+                                       # committed baseline (all other
+                                       # checks untouched)
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import tempfile
@@ -37,6 +46,32 @@ BASELINE = os.path.join(
     "obs_gate_baseline_cpu.json")
 
 SMOKE_STEPS = 4
+
+# Sub-smoke registry: name -> the metrics kinds its grafted record(s)
+# carry, i.e. exactly the committed baseline checks that ``--only NAME``
+# runs and (with --write-baseline) re-stamps. The main canonical run
+# always executes — it hosts the grafted records the gate reads.
+SMOKES = {
+    "recovery": ("inject", "recovery"),
+    "twostage": ("twostage",),
+    "codec": ("codec",),
+    "plan": ("plan",),
+    "bucket": ("bucket",),
+    "overlap": ("overlap",),
+    "calib": ("calib", "regress"),
+    "mem": ("mem",),
+    "critpath": ("critpath",),
+    "goodput": ("goodput",),
+    "lint": ("lint",),
+}
+# Sub-smokes a selected one cannot run without: the plan A/B reuses the
+# codec smoke's fp32 arms as its tree baseline.
+SMOKE_DEPS = {"plan": ("codec",)}
+
+
+def _selected(name: str, only) -> bool:
+    return (only is None or name == only
+            or name in SMOKE_DEPS.get(only, ()))
 
 
 def smoke_config(out_dir: str):
@@ -748,8 +783,107 @@ def run_mem_smoke(out_dir: str) -> dict:
     }
 
 
-def run_smoke(out_dir: str) -> str:
+def run_goodput_smoke(out_dir: str) -> dict:
+    """Goodput-ledger smoke (the goodput tentpole's consumer): a clean
+    and a chaos leg of the canonical run under the default ledger
+    (``--obs-goodput``), returning the fields the main run logs as ONE
+    "goodput" record so the drift gate can pin the PR's acceptance
+    numbers:
+
+      clean leg (4 steps)        rc==0; the end-of-run record is final;
+                                 CONSERVATION by measurement — the
+                                 taxonomy explains the wall clock:
+                                 clean_other_frac pinned <= 0.05 (atol)
+                                 and clean_conservation_err ~ 0 (the
+                                 |wall - sum(categories+other)| residual
+                                 is a construction invariant)
+      chaos leg (6 steps)        nan_grad@2 claimed by nan_loss=skip,
+                                 slow_rank:0:0.2@3-4, preempt@5: each
+                                 injected fault must land in its
+                                 DESIGNATED badput category —
+                                 chaos_wasted_hit   the skipped step's
+                                                    wall in `wasted`
+                                                    (n_wasted_steps>=1)
+                                 chaos_wait_hit     the injected 0.2 s
+                                                    sleeps in `wait`
+                                 chaos_ckpt_hit     the emergency save
+                                                    in `ckpt`
+                                 chaos_rc           the preemption exits
+                                                    45 WITH the final
+                                                    goodput record on
+                                                    disk first
+                                                    (record-before-exit)
+
+    The hit fields are one-sided indicators (1.0 exact); the clean-leg
+    fracs are timing-dependent, so only the conservation remainder is
+    pinned (loose atol), never the split itself."""
+    import json as _json
+
+    from gtopkssgd_tpu import dist_trainer
+    from gtopkssgd_tpu.obs import goodput as _goodput
+
+    canon = [
+        "--dnn", "resnet20", "--batch-size", "4", "--nworkers", "2",
+        "--compression", "gtopk_layerwise", "--density", "0.01",
+        "--seed", "42", "--eval-batches", "1", "--log-interval", "1",
+        "--obs-interval", "1", "--obs-goodput-interval", "2",
+    ]
+
+    def _final_goodput(d):
+        with open(os.path.join(d, "metrics.jsonl")) as fh:
+            recs = [_json.loads(line) for line in fh]
+        finals = [r for r in recs if r.get("kind") == "goodput"
+                  and r.get("final")]
+        return finals[-1] if finals else None
+
+    clean_dir = os.path.join(out_dir, "goodput_clean")
+    clean_rc = dist_trainer.main(canon + [
+        "--num-iters", "4", "--out-dir", clean_dir])
+    clean = _final_goodput(clean_dir) or {}
+
+    chaos_dir = os.path.join(out_dir, "goodput_chaos")
+    chaos_rc = dist_trainer.main(canon + [
+        "--num-iters", "6",
+        "--inject", "nan_grad@2,slow_rank:0:0.2@3-4,preempt@5",
+        "--recover-policy", "nan_loss=skip",
+        "--out-dir", chaos_dir])
+    chaos = _final_goodput(chaos_dir) or {}
+
+    def _s(rec, cat):
+        return float(rec.get(f"{cat}_s", 0.0))
+
+    return {
+        "clean_rc": float(clean_rc),
+        "clean_final": float(bool(clean.get("final"))),
+        "clean_goodput_frac": float(clean.get("goodput_frac", -1.0)),
+        "clean_other_frac": float(clean.get("other_frac", 1.0)),
+        "clean_conservation_err": (
+            round(_goodput.conservation_error(clean), 9) if clean
+            else -1.0),
+        "chaos_rc": float(chaos_rc),
+        "chaos_final": float(bool(chaos.get("final"))),
+        "chaos_n_wasted": float(chaos.get("n_wasted_steps", 0)),
+        "chaos_wasted_hit": float(_s(chaos, "wasted") > 0.0
+                                  and chaos.get("n_wasted_steps", 0) >= 1),
+        # two injected 0.2 s sleeps; >= 0.15 tolerates clock slop while
+        # still requiring at least one to have been accounted as wait
+        "chaos_wait_hit": float(_s(chaos, "wait") >= 0.15),
+        "chaos_ckpt_hit": float(_s(chaos, "ckpt") > 0.0),
+        "chaos_wait_s": round(_s(chaos, "wait"), 6),
+        "chaos_wasted_s": round(_s(chaos, "wasted"), 6),
+        "chaos_conservation_err": (
+            round(_goodput.conservation_error(chaos), 9) if chaos
+            else -1.0),
+    }
+
+
+def run_smoke(out_dir: str, only=None) -> str:
     """Train the canonical run; returns the run dir (metrics.jsonl inside).
+
+    ``only`` (a SMOKES name) restricts the sub-smokes to that one (plus
+    its SMOKE_DEPS); the canonical main run still executes — it hosts
+    the grafted records — but only the selected smoke's records enter
+    the stream, matching the subset gate main() builds for ``--only``.
 
     After the baseline steps, two more run under the profiler
     (obs.trace_attr.capture — Python tracer off, so op events survive)
@@ -777,15 +911,27 @@ def run_smoke(out_dir: str) -> str:
     # polluting the main run's value statistics. The twostage A/B runs
     # the same way: its sub-runs live in subdirs and only the single
     # summary record enters this run's stream.
-    rec_dir = run_recovery_smoke(out_dir)
-    twostage_rec = run_twostage_smoke(out_dir)
-    codec_rec = run_codec_smoke(out_dir)
-    plan_rec = run_plan_smoke(out_dir, codec_rec)
-    bucket_rec = run_bucket_smoke(out_dir)
-    overlap_rec = run_overlap_smoke(out_dir)
-    calib_rec = run_calib_smoke(out_dir)
-    mem_rec = run_mem_smoke(out_dir)
-    critpath_rec, critpath_real = run_critpath_smoke(out_dir)
+    rec_dir = (run_recovery_smoke(out_dir)
+               if _selected("recovery", only) else None)
+    twostage_rec = (run_twostage_smoke(out_dir)
+                    if _selected("twostage", only) else None)
+    codec_rec = (run_codec_smoke(out_dir)
+                 if _selected("codec", only) else None)
+    plan_rec = (run_plan_smoke(out_dir, codec_rec)
+                if _selected("plan", only) else None)
+    bucket_rec = (run_bucket_smoke(out_dir)
+                  if _selected("bucket", only) else None)
+    overlap_rec = (run_overlap_smoke(out_dir)
+                   if _selected("overlap", only) else None)
+    calib_rec = (run_calib_smoke(out_dir)
+                 if _selected("calib", only) else None)
+    mem_rec = (run_mem_smoke(out_dir)
+               if _selected("mem", only) else None)
+    goodput_rec = (run_goodput_smoke(out_dir)
+                   if _selected("goodput", only) else None)
+    critpath_rec = critpath_real = None
+    if _selected("critpath", only):
+        critpath_rec, critpath_real = run_critpath_smoke(out_dir)
 
     cfg = smoke_config(out_dir)
     with Trainer(cfg) as t:
@@ -811,55 +957,71 @@ def run_smoke(out_dir: str) -> str:
         # run's stream (re-stamped time/rank) so the gate's structural
         # recovery checks (exactly one firing, n_recoveries, completed)
         # read from the same metrics.jsonl as everything else.
-        rec_records, _ = report.load_records(rec_dir)
-        for r in rec_records:
-            if r.get("kind") in ("inject", "recovery"):
-                t.metrics.log(r["kind"], **{
-                    k: v for k, v in r.items()
-                    if k not in ("kind", "time", "rank")})
+        if rec_dir is not None:
+            rec_records, _ = report.load_records(rec_dir)
+            for r in rec_records:
+                if r.get("kind") in ("inject", "recovery"):
+                    t.metrics.log(r["kind"], **{
+                        k: v for k, v in r.items()
+                        if k not in ("kind", "time", "rank")})
         # Same graft for the twostage A/B evidence: the gate pins the
         # audited recall floor and the one-sided T_select regression.
-        t.metrics.log("twostage", **twostage_rec)
+        if twostage_rec is not None:
+            t.metrics.log("twostage", **twostage_rec)
         # And the wire-codec A/B: int8-vs-fp32 wire-bytes ratios, the
         # one-sided >=3x DCN-reduction evidence, the audited recall
         # floor under the lossy codec, and the ledger's modeled-vs-
         # measured bytes ratio.
-        t.metrics.log("codec", **codec_rec)
+        if codec_rec is not None:
+            t.metrics.log("codec", **codec_rec)
         # And the comm-planner A/B: balanced-vs-tree measured wire
         # ratios, the recall floor under the balanced schedule, and the
         # plan-keyed ledger's modeled-vs-measured bytes ratio. (The
         # trainer already logged this run's own "plan" decision record,
         # whose plan_is_default=1.0 the baseline pins — defaults keep
         # the historical tree wire.)
-        t.metrics.log("plan", **plan_rec)
+        if plan_rec is not None:
+            t.metrics.log("plan", **plan_rec)
         # And the bucketing A/B: leaf-vs-auto collective counts (the
         # one-sided >=3x fewer-merges evidence), the audited recall
         # floor on the bucketed arm, and the bucket-summed ledger's
         # modeled-vs-measured bytes ratio.
-        t.metrics.log("bucket", **bucket_rec)
+        if bucket_rec is not None:
+            t.metrics.log("bucket", **bucket_rec)
         # And the overlapped-pipeline A/B: exact-zero serial-vs-overlap
         # bit-identity deltas (fp32 + int8), the measured overlap_frac
         # from the pipelined arm's trace capture, the recall floor, and
         # the model-side DP crossover pin (B>1 under overlap pricing at
         # ResNet-50/alpha=0.1). Durable evidence -> flush=True.
-        t.metrics.log("overlap", flush=True, **overlap_rec)
+        if overlap_rec is not None:
+            t.metrics.log("overlap", flush=True, **overlap_rec)
         # And the calibration smoke: the robust fit pinned against its
         # synthetic ground truth, the exact refit/drift-firing counts,
         # the closed obs->planner artifact round-trip, and (as a
         # separate "regress" record) the registry CLI's exit-code
         # contract. Both kinds are durable -> flush=True.
-        _regress_keys = ("regress_rc_empty", "regress_rc_pass",
-                         "regress_rc_fail", "history_rc")
-        t.metrics.log("calib", flush=True, **{
-            k: v for k, v in calib_rec.items() if k not in _regress_keys})
-        t.metrics.log("regress", flush=True, **{
-            k: v for k, v in calib_rec.items() if k in _regress_keys})
+        if calib_rec is not None:
+            _regress_keys = ("regress_rc_empty", "regress_rc_pass",
+                             "regress_rc_fail", "history_rc")
+            t.metrics.log("calib", flush=True, **{
+                k: v for k, v in calib_rec.items()
+                if k not in _regress_keys})
+            t.metrics.log("regress", flush=True, **{
+                k: v for k, v in calib_rec.items() if k in _regress_keys})
         # And the compile/memory-plane smoke: one-executable discipline
         # on the clean leg (recompile_count 0, one compile record, the
         # manifest's peak-HBM matched and registry-carried) and the full
         # storm chain on the chaos leg (reshape -> retrace -> exactly
         # one recompile -> exit 44).
-        t.metrics.log("mem", **mem_rec)
+        if mem_rec is not None:
+            t.metrics.log("mem", **mem_rec)
+        # And the goodput smoke: the clean leg's conservation pins
+        # (other_frac <= 0.05, construction-invariant remainder ~0) and
+        # the chaos leg's fault-to-category indicators (skip -> wasted,
+        # slow_rank -> wait, emergency save -> ckpt, preempt -> 45 with
+        # the final record durable first). Durable -> flush=True.
+        if goodput_rec is not None:
+            t.metrics.log("goodput", flush=True, **goodput_rec)
         # And the critical-path smoke: one REAL per-step stage-interval
         # record from the overlap arm (so the registry's wait_frac /
         # crit_stage_modal path runs on gate data) plus the summary the
@@ -869,13 +1031,15 @@ def run_smoke(out_dir: str) -> str:
         # Durable evidence -> flush=True on both.
         if critpath_real is not None:
             t.metrics.log("critpath", flush=True, **critpath_real)
-        t.metrics.log("critpath", flush=True, **critpath_rec)
+        if critpath_rec is not None:
+            t.metrics.log("critpath", flush=True, **critpath_rec)
         # Static-analysis gate: run graftlint in-process over the
         # package + benchmarks against the committed repo baseline and
         # record the counts; the gate pins non_baselined at exactly 0,
         # so a new invariant violation fails the same drift gate as a
         # numeric regression.
-        t.metrics.log("lint", **run_lint_smoke())
+        if _selected("lint", only):
+            t.metrics.log("lint", **run_lint_smoke())
     return out_dir
 
 
@@ -1014,6 +1178,64 @@ def run_lint_smoke() -> dict:
     }
 
 
+def _write_subset_baseline(out_dir: str, name: str) -> str:
+    """Extract the committed baseline checks the named sub-smoke owns
+    (by kind; layer checks never belong to a sub-smoke) into a derived
+    subset file inside the run dir. Manifest pins are dropped — the
+    subset run's manifest is the main run's, and those pins belong to
+    the full gate."""
+    with open(BASELINE) as fh:
+        base = json.load(fh)
+    kinds = set(SMOKES[name])
+    checks = [c for c in base.get("checks", [])
+              if c.get("layer") is None and c.get("kind") in kinds]
+    if not checks:
+        raise SystemExit(
+            f"--only {name}: the committed baseline has no checks with "
+            f"kind in {sorted(kinds)} — add the check specs to "
+            f"{os.path.basename(BASELINE)} first, then re-stamp their "
+            f"expectations with --only {name} --write-baseline")
+    sub = {
+        "description": (f"{name} subset of {os.path.basename(BASELINE)} "
+                        "(derived per run; not committed)"),
+        "checks": checks,
+    }
+    path = os.path.join(out_dir, f"gate_subset_{name}.json")
+    with open(path, "w") as fh:
+        json.dump(sub, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def _merge_subset_baseline(restamped_path: str) -> None:
+    """Fold a re-stamped subset back into the committed baseline:
+    each subset check replaces the committed check with the same
+    identity (report._check_id), everything else — other checks, their
+    order, the manifest pins — is untouched. This is what makes
+    ``--only NAME --write-baseline`` safe: it can only move the
+    expectations the named sub-smoke owns."""
+    from gtopkssgd_tpu.obs.report import _check_id
+
+    with open(restamped_path) as fh:
+        restamped = {_check_id(c): c for c in json.load(fh)["checks"]}
+    with open(BASELINE) as fh:
+        base = json.load(fh)
+    merged = 0
+    for i, check in enumerate(base.get("checks", [])):
+        new = restamped.pop(_check_id(check), None)
+        if new is not None:
+            base["checks"][i] = new
+            merged += 1
+    # A subset check absent from the committed list can only mean the
+    # committed file changed under us; append rather than drop it.
+    base["checks"].extend(restamped.values())
+    with open(BASELINE, "w") as fh:
+        json.dump(base, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(f"merged {merged + len(restamped)} re-stamped check(s) "
+          f"into {BASELINE}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         "obs_gate_smoke",
@@ -1022,6 +1244,12 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="re-stamp the committed baseline's expectations "
                          "from this run instead of failing on drift")
+    ap.add_argument("--only", choices=sorted(SMOKES), default=None,
+                    help="run ONE sub-smoke (plus its dependencies) and "
+                         "gate just the baseline checks its kinds own; "
+                         "with --write-baseline, merge only those "
+                         "re-stamped checks back into the committed "
+                         "baseline")
     ap.add_argument("--out-dir", default=None,
                     help="keep the run here (default: a temp dir)")
     args = ap.parse_args(argv)
@@ -1035,10 +1263,20 @@ def main(argv=None) -> int:
     enable_compilation_cache()
 
     out = args.out_dir or tempfile.mkdtemp(prefix="obs_gate_smoke_")
-    run_smoke(out)
+    os.makedirs(out, exist_ok=True)
 
     from gtopkssgd_tpu.obs import report
 
+    if args.only:
+        subset = _write_subset_baseline(out, args.only)
+        run_smoke(out, only=args.only)
+        write = subset + ".new" if args.write_baseline else None
+        rc = report.run_gate(out, subset, write=write)
+        if write and os.path.exists(write):
+            _merge_subset_baseline(write)
+        return rc
+
+    run_smoke(out)
     write = BASELINE if args.write_baseline else None
     return report.run_gate(out, BASELINE, write=write)
 

@@ -1,8 +1,9 @@
-"""The graftlint rule set — five invariants, each born from a real bug
+"""The graftlint rule set — six invariants, each born from a real bug
 or a convention that was previously enforced by grep, docstring, or
 reviewer memory.
 
-Registry-backed rules (metric-kind, exit-code) read their registries
+Registry-backed rules (metric-kind, exit-code, event-rule) read their
+registries
 from the package SOURCE by AST — never by import, which would
 initialize a JAX backend — so the analyzer stays silicon-free. When the
 scanned file set itself contains ``utils/metrics.py`` / a registry
@@ -112,6 +113,26 @@ def registered_kinds(files: Sequence[SourceFile] = ()) -> Set[str]:
                     leaf.value, str):
                 kinds.add(leaf.value)
     return kinds
+
+
+def registered_event_rules(files: Sequence[SourceFile] = ()) -> Set[str]:
+    """``obs.events.RULES`` (the anomaly rule-name registry) recovered
+    from source, same AST-only discipline as ``registered_kinds``."""
+    tree = _load_source(files, "obs/events.py")
+    rules: Set[str] = set()
+    if tree is None:
+        return rules
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "RULES"
+                   for t in node.targets):
+            continue
+        for leaf in ast.walk(node.value):
+            if isinstance(leaf, ast.Constant) and isinstance(
+                    leaf.value, str):
+                rules.add(leaf.value)
+    return rules
 
 
 def exit_code_registry(
@@ -508,7 +529,7 @@ class DurableEventRule:
     name = "durable-event"
 
     DURABLE_KINDS = {"event", "inject", "recovery", "calib", "regress",
-                     "compile", "overlap", "critpath"}
+                     "compile", "overlap", "critpath", "goodput"}
 
     def run(self, files: Sequence[SourceFile]) -> List[Finding]:
         findings: List[Finding] = []
@@ -530,12 +551,63 @@ class DurableEventRule:
         return findings
 
 
+# --------------------------------------------------------------------------
+# Rule 6: event-rule
+# --------------------------------------------------------------------------
+
+class EventRuleRule:
+    """Every anomaly-event rule name stamped at an emit site must be a
+    member of ``obs.events.RULES`` — the event-plane mirror of the
+    metric-kind rule. ``_emit`` already rejects unregistered names at
+    runtime; this rule catches the typo before any run, at the two
+    static shapes emit sites take: a dict literal with a ``"rule"`` key
+    (the monitor's own event records) and the first argument of a local
+    ``fire(...)`` helper (the threshold-rule bodies)."""
+
+    name = "event-rule"
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        rules = registered_event_rules(files)
+        if not rules:
+            return []
+        findings: List[Finding] = []
+        for sf in files:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Dict):
+                    for key, val in zip(node.keys, node.values):
+                        if (isinstance(key, ast.Constant)
+                                and key.value == "rule"
+                                and isinstance(val, ast.Constant)
+                                and isinstance(val.value, str)
+                                and val.value not in rules):
+                            findings.append(_finding(
+                                self.name, sf, node,
+                                f"unregistered event rule "
+                                f"{val.value!r} — add it to "
+                                "gtopkssgd_tpu.obs.events.RULES (and "
+                                "the README event table)"))
+                elif (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "fire" and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and node.args[0].value not in rules):
+                    findings.append(_finding(
+                        self.name, sf, node,
+                        f"unregistered event rule "
+                        f"{node.args[0].value!r} — add it to "
+                        "gtopkssgd_tpu.obs.events.RULES (and the "
+                        "README event table)"))
+        return findings
+
+
 ALL_RULES = (
     HostSyncInJitRule(),
     MetricKindRule(),
     ExitCodeRule(),
     CodecWireRule(),
     DurableEventRule(),
+    EventRuleRule(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

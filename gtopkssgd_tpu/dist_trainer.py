@@ -223,6 +223,35 @@ reference's only telemetry was text logs):
                                          fraction above which
                                          hbm_headroom fires (default
                                          0.92)
+    --obs-goodput / --no-obs-goodput     goodput/badput wall-clock
+                                         ledger (obs.goodput): partition
+                                         the run's measured wall into
+                                         productive step compute vs the
+                                         closed badput taxonomy (select,
+                                         comm, wait, compile, ckpt,
+                                         wasted, degraded, data,
+                                         startup), unattributed
+                                         remainder surfaced as
+                                         other_frac (conservation: the
+                                         categories sum to wall by
+                                         construction). Pure host
+                                         arithmetic at sync points the
+                                         loop already pays — default on.
+                                         Inspect with 'report goodput'
+                                         (per-rank bars, --compare,
+                                         --advise eviction hint)
+    --obs-goodput-interval N             optimizer steps between
+                                         periodic durable 'goodput'
+                                         records (default 50; <= 0
+                                         keeps only the end-of-run
+                                         summary). Each record feeds
+                                         the goodput_collapse rule
+    --obs-goodput-collapse-windows K     consecutive ledger records
+                                         with goodput_frac below half
+                                         its own EWMA before the
+                                         goodput_collapse anomaly fires
+                                         (default 3; honors
+                                         --obs-halt-on like every rule)
     --registry DIR                       append one summary line per run
                                          to DIR/runs.jsonl (obs.registry:
                                          manifest header + steps/sec,
@@ -512,6 +541,26 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="bytes_in_use/bytes_limit fraction above which "
                         "hbm_headroom fires (backends without "
                         "memory_stats never trip it)")
+    p.add_argument("--obs-goodput", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="goodput/badput wall-clock ledger (obs.goodput): "
+                        "partition measured wall into productive step "
+                        "compute vs the badput taxonomy (select/comm/"
+                        "wait/compile/ckpt/wasted/degraded/data/startup) "
+                        "with the unattributed remainder surfaced as "
+                        "other_frac; cumulative durable 'goodput' "
+                        "records + an end-of-run summary. Host-side "
+                        "arithmetic only — default on; inspect with "
+                        "'report goodput'")
+    p.add_argument("--obs-goodput-interval", type=int, default=50,
+                   help="optimizer steps between periodic durable "
+                        "'goodput' records (<= 0 keeps only the "
+                        "end-of-run summary); each record feeds the "
+                        "goodput_collapse rule")
+    p.add_argument("--obs-goodput-collapse-windows", type=int, default=3,
+                   help="consecutive ledger records with goodput_frac "
+                        "below half its own EWMA before goodput_collapse "
+                        "fires (honors --obs-halt-on)")
     p.add_argument("--registry", default=None, metavar="DIR",
                    help="append this run's summary line (manifest subset "
                         "+ steps/sec, comm ratio, fitted alpha/beta, "
@@ -620,6 +669,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         obs_recompile_warmup=args.obs_recompile_warmup,
         obs_mem_leak_windows=args.obs_mem_leak_windows,
         obs_hbm_headroom_frac=args.obs_hbm_headroom_frac,
+        obs_goodput=args.obs_goodput,
+        obs_goodput_interval=args.obs_goodput_interval,
+        obs_goodput_collapse_windows=args.obs_goodput_collapse_windows,
         registry=args.registry,
         comm_model_fit=args.comm_model_fit,
         inject=args.inject,

@@ -70,6 +70,23 @@ synced (no extra device reads):
                                   then adopts the new stage as modal
                                   and re-arms. Fed by the fleet merger
                                   through ``observe_critpath``
+  goodput_collapse      warn      the run's cumulative goodput_frac
+                                  (obs/goodput.py ledger) dropped below
+                                  ``goodput_collapse_frac`` x its own
+                                  EWMA for ``goodput_collapse_windows``
+                                  CONSECUTIVE ledger observations —
+                                  wall-clock is still passing but it
+                                  stopped buying training progress
+                                  (storm of waits/recoveries/ckpts).
+                                  Fed by the GoodputLedger's periodic
+                                  durable records through
+                                  ``observe_goodput``
+
+Every rule name is registered in the module-level ``RULES`` frozenset
+(the event-plane mirror of ``utils/metrics.KINDS``): ``_emit`` rejects
+unregistered names at runtime, graftlint's event-rule check rejects them
+statically at emit sites, and a tier-1 doc-drift test pins the README's
+event table to exactly this set.
 
 Each firing emits one severity-tagged ``event`` record through
 MetricsLogger with ``flush=True`` (fsync'd — a run killed one line later
@@ -92,6 +109,26 @@ from typing import Any, Dict, List, Optional
 from gtopkssgd_tpu.exit_codes import EXIT_ANOMALY_HALT as HALT_EXIT_CODE
 
 _SEVERITY_RANK = {"info": 0, "warn": 1, "error": 2}
+
+# Every rule name the monitor may emit — the event-plane mirror of
+# utils/metrics.KINDS. An event record whose "rule" is not here is a
+# bug (typo'd emit site, undocumented rule): _emit raises, graftlint's
+# event-rule check flags the emit site statically, and the README
+# doc-drift test keeps the event table covering exactly this set.
+RULES = frozenset({
+    "nan_loss",              # non-finite loss (error)
+    "loss_spike",            # loss EWMA z-score excursion
+    "density_collapse",      # achieved density << configured rho
+    "residual_blowup",       # error-feedback residual diverging
+    "residual_age_runaway",  # starved coordinates (stale residuals)
+    "straggler_persistent",  # one rank late at EVERY sync point
+    "comm_model_drift",      # live alpha/beta fit off the planner's
+    "recompile_storm",       # executable cache growing on the hot step
+    "device_mem_leak",       # live bytes growing monotonically
+    "hbm_headroom",          # bytes_in_use near bytes_limit
+    "critpath_shift",        # global critical stage moved
+    "goodput_collapse",      # goodput_frac fell off its own EWMA
+})
 
 
 class AnomalyHalt(RuntimeError):
@@ -135,6 +172,15 @@ class Thresholds:
                                      # global critical stage differs
                                      # from the modal one before
                                      # critpath_shift fires
+    goodput_collapse_windows: int = 3    # consecutive ledger records
+                                         # below the drop threshold
+                                         # before goodput_collapse fires
+    goodput_collapse_frac: float = 0.5   # current goodput_frac < frac *
+                                         # its EWMA counts as a drop
+    goodput_ewma_alpha: float = 0.3      # EWMA decay for goodput_frac
+    goodput_warmup: int = 2          # ledger records before the
+                                     # collapse rule arms (early-run
+                                     # fractions are startup-dominated)
 
     def age_max(self, rho: Optional[float]) -> float:
         if self.residual_age_max > 0:
@@ -219,6 +265,12 @@ class AnomalyMonitor:
         self._crit_modal: Optional[str] = None
         self._crit_streak = 0
         self._crit_streak_stage: Optional[str] = None
+        # Goodput state (observe_goodput): EWMA of the run's cumulative
+        # goodput_frac, observations seen, and the current below-
+        # threshold streak.
+        self._gp_ewma: Optional[float] = None
+        self._gp_n = 0
+        self._gp_streak = 0
 
     # ---------------------------------------------------------- the rules
     def _check(self, step: int, loss: Optional[float],
@@ -472,6 +524,48 @@ class AnomalyMonitor:
             self._crit_streak_stage = None
         return out
 
+    # ------------------------------------------------ goodput (ledger)
+    def _check_goodput(self, step: int, goodput_frac: Optional[float]
+                       ) -> List[Dict[str, Any]]:
+        th = self.th
+        out: List[Dict[str, Any]] = []
+        if not _finite(goodput_frac):
+            return out
+        frac = float(goodput_frac)
+        # Arm-before-update, like the straggler/drift rules: the first
+        # goodput_warmup ledger records (startup-dominated fractions)
+        # establish the EWMA and can never fire; afterwards, a record
+        # below goodput_collapse_frac x the EWMA extends the streak, a
+        # recovered record resets it.
+        if (self._gp_n >= th.goodput_warmup and self._gp_ewma is not None
+                and self._gp_ewma > 0
+                and frac < th.goodput_collapse_frac * self._gp_ewma):
+            self._gp_streak += 1
+        else:
+            self._gp_streak = 0
+        if self._gp_streak >= th.goodput_collapse_windows:
+            out.append({
+                "rule": "goodput_collapse", "severity": "warn",
+                "step": step, "value": round(frac, 6),
+                "threshold": round(
+                    th.goodput_collapse_frac * self._gp_ewma, 6),
+                "message": (f"goodput_frac {frac:.3g} stayed below "
+                            f"{th.goodput_collapse_frac:g} x its EWMA "
+                            f"{self._gp_ewma:.3g} for "
+                            f"{self._gp_streak} consecutive ledger "
+                            "records — wall-clock has stopped buying "
+                            "training progress"),
+            })
+            # Re-arm: the EWMA keeps updating with the collapsed
+            # fractions below, so a sustained new level is adopted and
+            # only a FURTHER collapse fires again.
+            self._gp_streak = 0
+        a = th.goodput_ewma_alpha
+        self._gp_ewma = (frac if self._gp_ewma is None
+                         else self._gp_ewma + a * (frac - self._gp_ewma))
+        self._gp_n += 1
+        return out
+
     # ------------------------------------------------------------- public
     def _emit(self, fired: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         """Record, persist (fsync'd), mark on the timeline, and — after
@@ -479,6 +573,11 @@ class AnomalyMonitor:
         halt severity. Shared by observe and observe_ranks."""
         halting = None
         for ev in fired:
+            if ev.get("rule") not in RULES:
+                raise ValueError(
+                    f"unregistered anomaly rule {ev.get('rule')!r} — "
+                    "add it to obs/events.RULES (and the README event "
+                    "table) before emitting it")
             # Offer the event to the recovery layer BEFORE the halt
             # decision: a claimed event is about to be recovered from,
             # so halting on it would defeat the policy. The claim is
@@ -563,6 +662,16 @@ class AnomalyMonitor:
         the fleet merger). Same emit/halt contract as observe — a moved
         bottleneck trips --obs-halt-on warn like any other anomaly."""
         return self._emit(self._check_critpath(step, crit_stage))
+
+    def observe_goodput(self, step: int, *,
+                        goodput_frac: Optional[float] = None
+                        ) -> List[Dict[str, Any]]:
+        """Evaluate the goodput_collapse rule against one periodic
+        ledger record's cumulative goodput_frac (obs/goodput.py). Same
+        emit/halt contract as observe — the ledger writes its durable
+        record BEFORE feeding the monitor, so the decomposition that
+        explains the collapse survives the exit-44 halt."""
+        return self._emit(self._check_goodput(step, goodput_frac))
 
     def summary(self) -> Dict[str, int]:
         """{rule: count} over the monitor's lifetime (test/report aid)."""

@@ -31,6 +31,12 @@ Pieces (all host-side, stdlib-only — report-CLI friendly):
       critical ``stage`` when that rank shipped critpath records — the
       difference between "rank 2 is late" and "rank 2 is late because
       its input pipeline (compute) is slow".
+  goodput_rows               per-rank goodput/badput decomposition
+      (obs/goodput.py fold: last cumulative ``goodput`` record per rank,
+      synthesized from critpath/compile/recovery evidence when a rank
+      shipped none) plus the whole-fleet wall-weighted decomposition —
+      the "what fraction of this fleet's rank-seconds advanced
+      training" view, and the input to ``report goodput --advise``.
   critpath_rows              join per-rank ``critpath`` stage-interval
       records (obs/critpath.py) by step into the GLOBAL critical path:
       per-step crit_rank/crit_stage/crit_frac + the (rank, stage) chain,
@@ -54,6 +60,7 @@ import os
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 from gtopkssgd_tpu.obs import critpath as _critpath
+from gtopkssgd_tpu.obs import goodput as _goodput
 from gtopkssgd_tpu.obs.events import AnomalyMonitor
 from gtopkssgd_tpu.obs.report import extract_manifest, load_records
 from gtopkssgd_tpu.utils.metrics import shard_filename, shard_rank
@@ -249,6 +256,65 @@ def pick_straggler_kind(records_by_rank: Mapping[int, List[dict]],
     return None
 
 
+def _goodput_by_rank(records_by_rank: Mapping[int, List[dict]]
+                     ) -> Dict[int, List[dict]]:
+    """{rank: [goodput records sorted by step]} — the cumulative ledger
+    stream each rank shipped (possibly empty)."""
+    out: Dict[int, List[dict]] = {}
+    for rank, records in records_by_rank.items():
+        recs = [r for r in records if r.get("kind") == "goodput"
+                and isinstance(r.get("step"), (int, float))
+                and not isinstance(r.get("step"), bool)]
+        if recs:
+            recs.sort(key=lambda r: float(r["step"]))
+            out[rank] = recs
+    return out
+
+
+def _badput_at(gp_recs: Optional[List[dict]], step: float
+               ) -> Tuple[Optional[str], Optional[float]]:
+    """(dominant badput category, its wall fraction) from the latest
+    cumulative goodput record at or before ``step`` (falling back to the
+    rank's first record when the straggler row predates the first ledger
+    log). (None, None) when the rank shipped no goodput records."""
+    if not gp_recs:
+        return None, None
+    rec = gp_recs[0]
+    for cand in gp_recs:
+        if float(cand["step"]) <= step:
+            rec = cand
+        else:
+            break
+    cat = _goodput.dominant_badput(rec)
+    if cat is None:
+        return None, None
+    return cat, _goodput.category_fracs(rec).get(cat)
+
+
+def goodput_rows(records_by_rank: Mapping[int, List[dict]]
+                 ) -> Tuple[List[dict], Dict[int, dict], Optional[dict]]:
+    """Per-rank goodput/badput decomposition + the fleet roll-up.
+
+    Returns (rows, decomp_by_rank, fleet). One row per rank: the folded
+    end-of-run decomposition (obs/goodput.py ``fold`` — last cumulative
+    ledger record, or a synthesis from critpath/compile/recovery
+    evidence when the rank shipped none) plus its dominant badput
+    category. ``fleet`` is the wall-weighted whole-fleet decomposition
+    (None for an empty fleet) — the single number ("this fleet's
+    rank-seconds were X% productive") and the input to ``advise``."""
+    decomp_by_rank = _goodput.fold_shards(records_by_rank)
+    rows: List[dict] = []
+    for rank in sorted(decomp_by_rank):
+        d = decomp_by_rank[rank]
+        row = {"src": "goodput", "field": "goodput", "rank": rank,
+               "badput": _goodput.dominant_badput(d)}
+        row.update({k: v for k, v in d.items() if k not in row})
+        rows.append(row)
+    fleet = (_goodput.fleet_decomposition(decomp_by_rank)
+             if decomp_by_rank else None)
+    return rows, decomp_by_rank, fleet
+
+
 def straggler_rows(records_by_rank: Mapping[int, List[dict]],
                    kind: Optional[str] = None,
                    monitor: Optional[AnomalyMonitor] = None
@@ -270,6 +336,11 @@ def straggler_rows(records_by_rank: Mapping[int, List[dict]],
     # at that step, when it shipped one): why that host was late, not
     # just that it was.
     crit_idx = _index_by_step(records_by_rank, ("critpath",))
+    # And its dominant badput category (from its cumulative ``goodput``
+    # records, when it shipped any): the decomposition's verdict on
+    # WHERE that host's lost time goes — wait vs wasted vs ckpt — which
+    # is the column ``report goodput --advise`` reasons from.
+    gp_idx = _goodput_by_rank(records_by_rank)
     by_step = _arrival_times(records_by_rank, kind)
     steps = sorted(by_step)
     med_arrivals = [_median(list(by_step[s].values())) for s in steps]
@@ -289,6 +360,7 @@ def straggler_rows(records_by_rank: Mapping[int, List[dict]],
         monitor.observe_ranks(step, lags, step_dur=step_dur)
         fired = monitor.events[events_before:]
         crec = crit_idx.get(("critpath", step), {}).get(slowest) or {}
+        badput, badput_frac = _badput_at(gp_idx.get(slowest), step)
         rows.append({
             "src": kind, "step": step, "field": "straggler",
             "n_ranks": len(times),
@@ -299,6 +371,8 @@ def straggler_rows(records_by_rank: Mapping[int, List[dict]],
             "persistent": any(ev["rule"] == "straggler_persistent"
                               for ev in fired),
             "stage": crec.get("crit_stage"),
+            "badput": badput,
+            "badput_frac": badput_frac,
         })
     return rows, list(monitor.events)
 
@@ -378,6 +452,7 @@ def merge(targets: Sequence[str],
         records_by_rank, kind=straggler_kind, monitor=monitor)
     crit_rows, crit_budget = critpath_rows(records_by_rank,
                                            monitor=monitor)
+    gp_rows, gp_by_rank, gp_fleet = goodput_rows(records_by_rank)
     return {
         "shards": {r: shards[r] for r in sorted(shards)},
         "ranks": sorted(shards),
@@ -387,6 +462,9 @@ def merge(targets: Sequence[str],
         "stragglers": stragglers,
         "critpath": crit_rows,
         "critpath_budget": crit_budget,
+        "goodput": gp_rows,
+        "goodput_by_rank": gp_by_rank,
+        "goodput_fleet": gp_fleet,
         "events": list(monitor.events),
     }
 

@@ -73,6 +73,13 @@ REGRESS_CHECKS: Tuple[Tuple[str, float, float], ...] = (
     # lives in [0, 1] and a clean baseline of 0.0 must still bound a
     # current run that started skewing.
     ("wait_frac", 0.0, 0.10),
+    # goodput_frac (productive share of the run's wall, from the
+    # goodput ledger's final summary — obs/goodput.py) is the single
+    # number the whole badput taxonomy rolls up to; purely absolute
+    # 0.1 slack for the same [0, 1] reason as the two above — a run
+    # whose productive share quietly dropped ten points under the same
+    # config is the regression this line pins.
+    ("goodput_frac", 0.0, 0.10),
 )
 
 # String-valued stats checked for EXACT equality (the numeric loop's
@@ -121,6 +128,7 @@ def run_summary(records: Sequence[Dict[str, Any]]
     crit_counts: Dict[str, int] = {}
     saw_memwatch = False
     recompile_count = 0
+    last_goodput = None
     for rec in records:
         kind = rec.get("kind")
         if kind == "manifest" and manifest is None:
@@ -168,6 +176,10 @@ def run_summary(records: Sequence[Dict[str, Any]]
             cs = rec.get("crit_stage")
             if isinstance(cs, str) and cs:
                 crit_counts[cs] = crit_counts.get(cs, 0) + 1
+        elif kind == "goodput":
+            # cumulative ledger records (obs/goodput.py): the LAST one
+            # is the run's accounting, so it alone feeds the entry.
+            last_goodput = rec
         elif kind == "recovery" and rec.get("final_status") is not None:
             final_status = rec.get("final_status")
     if manifest is None:
@@ -208,6 +220,13 @@ def run_summary(records: Sequence[Dict[str, Any]]
         stats["overlap_frac"] = round(ofrac_sum / ofrac_n, 6)
     if wait_n:
         stats["wait_frac"] = round(wait_sum / wait_n, 6)
+    if last_goodput is not None:
+        if _finite(last_goodput.get("goodput_frac")):
+            stats["goodput_frac"] = round(
+                float(last_goodput["goodput_frac"]), 6)
+        if _finite(last_goodput.get("other_frac")):
+            stats["other_frac"] = round(
+                float(last_goodput["other_frac"]), 6)
     if crit_counts:
         # Modal stage; ties break by critpath.STAGES order (inlined as
         # a sort over the fixed tuple to keep the registry stdlib-only).
@@ -300,6 +319,7 @@ def history_rows(entries: Sequence[Dict[str, Any]],
             _cell(stats.get("overlap_frac")),
             str(stats.get("crit_stage_modal", "-")),
             _cell(stats.get("wait_frac")),
+            _cell(stats.get("goodput_frac")),
             str(stats.get("final_status", "-")),
         ])
     return rows
@@ -308,7 +328,8 @@ def history_rows(entries: Sequence[Dict[str, Any]],
 HISTORY_HEADER = ["config", "git", "steps", "steps/s", "loss",
                   "comm_ratio", "alpha_ms", "beta_gbps", "recall",
                   "wireB/step", "peak_hbm", "recomp", "pipeline", "B",
-                  "ovl_frac", "crit_stage", "wait_frac", "status"]
+                  "ovl_frac", "crit_stage", "wait_frac", "goodput",
+                  "status"]
 
 
 def pick_baseline(entry: Dict[str, Any],

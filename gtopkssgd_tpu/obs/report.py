@@ -18,6 +18,11 @@ or gate one against a committed baseline.
                                                         # critical path: which
                                                         # (rank, stage) bounds
                                                         # each step, wait split
+    python -m gtopkssgd_tpu.obs.report goodput <run>...
+                                                        # goodput/badput
+                                                        # decomposition per
+                                                        # rank + fleet roll-up
+                                                        # (--advise, --compare)
     python -m gtopkssgd_tpu.obs.report watch <run>...   # live tail-follow
     python -m gtopkssgd_tpu.obs.report ledger <run>...  # comm model vs measured
     python -m gtopkssgd_tpu.obs.report history <dir>    # registry trend table
@@ -850,6 +855,64 @@ def run_critpath(targets: Sequence[str], json_out: Optional[str] = None,
     return 0
 
 
+def run_goodput(targets: Sequence[str], json_out: Optional[str] = None,
+                allow_mismatch: bool = False, advise: bool = False,
+                compare: Optional[str] = None) -> int:
+    """``goodput`` subcommand: per-rank goodput/badput decomposition
+    (obs/goodput.py) — category table, per-rank goodput bars, the
+    whole-fleet wall-weighted roll-up; ``--compare OTHER`` diffs this
+    run's fleet decomposition against another run's (the chaos-vs-clean
+    view); ``--advise`` prints the eviction hint (which rank's badput
+    drags furthest below the fleet median, and what evicting it would
+    recover)."""
+    from gtopkssgd_tpu.obs import fleet
+    from gtopkssgd_tpu.obs import goodput as _goodput
+
+    try:
+        shards = fleet.resolve_targets(list(targets))
+        records_by_rank, bad = fleet.load_shards(shards)
+        fleet.validate_shards(records_by_rank,
+                              allow_mismatch=allow_mismatch)
+    except (OSError, ValueError) as e:
+        print(f"cannot merge {list(targets)}: {e}")
+        return 2
+    if bad:
+        print(f"note: skipped {bad} malformed line(s)")
+    decomp = _goodput.fold_shards(records_by_rank)
+    if not decomp:
+        print("goodput: no goodput records and nothing to synthesize "
+              "from (run with --obs-goodput, the default)")
+        return 1
+    fleet_rec = _goodput.fleet_decomposition(decomp)
+    cmp_decomp = None
+    if compare:
+        try:
+            cshards = fleet.resolve_targets([compare])
+            crecs, cbad = fleet.load_shards(cshards)
+            if cbad:
+                print(f"note: {compare}: skipped {cbad} malformed "
+                      "line(s)")
+            cmp_decomp = _goodput.fold_shards(crecs) or None
+        except (OSError, ValueError) as e:
+            print(f"cannot read compare run {compare}: {e}")
+            return 2
+    hint = _goodput.advise(decomp) if advise else None
+    print(f"goodput: ranks={sorted(decomp)}")
+    print(_goodput.format_goodput(decomp, fleet=fleet_rec,
+                                  compare=cmp_decomp, hint=hint))
+    if advise and hint is None:
+        print("advise: no outlier — every rank within margin of the "
+              "fleet median goodput_frac")
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump({"by_rank": decomp, "fleet": fleet_rec,
+                       "compare": cmp_decomp, "advise": hint},
+                      fh, indent=1, sort_keys=True, default=str)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0
+
+
 def run_watch(targets: Sequence[str], interval: float = 2.0,
               iterations: Optional[int] = None, out=None) -> int:
     """``watch`` subcommand: tail-follow one or many shards, printing a
@@ -939,6 +1002,12 @@ def run_watch(targets: Sequence[str], interval: float = 2.0,
                     # this rank's local critical stage (latest critpath
                     # record) — why it is slow, not just that it is.
                     bits.append(f"crit_stage={cp['crit_stage']}")
+                gp = last.get("goodput")
+                if gp is not None and isinstance(
+                        gp.get("goodput_frac"), (int, float)):
+                    # latest cumulative ledger record (--obs-goodput):
+                    # this rank's productive share of wall so far.
+                    bits.append(f"goodput_frac={_fmt(gp['goodput_frac'])}")
                 mem = last.get("mem")
                 if mem is not None:
                     # space-plane gauges (--obs-mem): same fields the
@@ -1645,6 +1714,33 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_critpath(a.targets, json_out=a.json_out,
                             allow_mismatch=a.allow_mismatch,
                             halt_on=a.halt_on)
+    if argv and argv[0] == "goodput":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report goodput",
+            description="Per-rank goodput/badput decomposition: what "
+                        "fraction of each rank's wall-clock advanced "
+                        "training, where the rest went (select/comm/"
+                        "wait/compile/ckpt/wasted/degraded/data/"
+                        "startup/other), and the whole-fleet roll-up.")
+        ap.add_argument("targets", nargs="+",
+                        help="run dirs holding metrics.rank*.jsonl (or "
+                             "metrics.jsonl), or shard paths")
+        ap.add_argument("--compare", default=None,
+                        help="second run to diff fleet decompositions "
+                             "against (chaos vs clean)")
+        ap.add_argument("--advise", action="store_true",
+                        help="print the eviction hint: the rank whose "
+                             "badput drags furthest below the fleet "
+                             "median goodput_frac, and the recoverable "
+                             "rank-seconds")
+        ap.add_argument("--json", dest="json_out", default=None)
+        ap.add_argument("--allow-mismatch", action="store_true",
+                        help="merge shards even when their manifest "
+                             "config_hash differs (normally refused)")
+        a = ap.parse_args(argv[1:])
+        return run_goodput(a.targets, json_out=a.json_out,
+                           allow_mismatch=a.allow_mismatch,
+                           advise=a.advise, compare=a.compare)
     if argv and argv[0] == "watch":
         ap = argparse.ArgumentParser(
             "gtopkssgd_tpu.obs.report watch",

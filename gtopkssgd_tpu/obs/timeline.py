@@ -140,6 +140,7 @@ class TimelineRecorder:
 _COUNTER_KINDS = {
     "train": ("loss", "throughput"),
     "obs": ("achieved_density", "residual_norm", "grad_norm_post", "tau"),
+    "goodput": ("goodput_frac", "other_frac"),
 }
 _MARKER_KINDS = ("event", "stall")
 
@@ -232,6 +233,20 @@ def timeline_from_records(records: List[dict],
             if vals:
                 body.append({"ph": "C", "name": kind, "ts": ts_us,
                              "pid": 0, "tid": 0, "args": vals})
+            if kind == "goodput":
+                # Badput track: cumulative seconds per category
+                # (obs/goodput.py taxonomy) as one stacked counter —
+                # the Perfetto view of WHERE non-productive wall
+                # accrues over the run.
+                from gtopkssgd_tpu.obs import goodput as _goodput
+                bad = {c: float(rec[f"{c}_s"])
+                       for c in _goodput.BADPUT + ("other",)
+                       if isinstance(rec.get(f"{c}_s"), (int, float))
+                       and not isinstance(rec.get(f"{c}_s"), bool)}
+                if bad:
+                    body.append({"ph": "C", "name": "badput_s",
+                                 "ts": ts_us, "pid": 0, "tid": 0,
+                                 "args": bad})
         elif kind in _MARKER_KINDS:
             name = (f"{kind}:{rec.get('rule', '?')}" if kind == "event"
                     else kind)

@@ -329,6 +329,28 @@ class TrainConfig:
                                    # fraction above which hbm_headroom
                                    # fires (backends without
                                    # memory_stats never arm it)
+    obs_goodput: bool = True       # goodput/badput wall-clock ledger
+                                   # (obs/goodput.py): partition the
+                                   # run's measured wall into productive
+                                   # step compute vs the badput taxonomy
+                                   # (select/comm/wait/compile/ckpt/
+                                   # wasted/degraded/data/startup), with
+                                   # the unattributed remainder surfaced
+                                   # as other_frac (conservation). Pure
+                                   # host arithmetic at sync points the
+                                   # loop already pays — on by default.
+                                   # One durable cumulative "goodput"
+                                   # record every obs_goodput_interval
+                                   # steps + an end-of-run summary
+    obs_goodput_interval: int = 50  # optimizer steps between periodic
+                                   # durable "goodput" records (<= 0
+                                   # keeps only the end-of-run summary);
+                                   # each record also feeds the
+                                   # goodput_collapse rule
+    obs_goodput_collapse_windows: int = 3  # consecutive ledger records
+                                   # with goodput_frac below half its
+                                   # EWMA before goodput_collapse fires
+                                   # (obs.events.Thresholds)
 
     # --- per-dataset defaults (the reference hardcoded these in DLTrainer) --
     def resolved(self) -> "TrainConfig":
@@ -417,6 +439,16 @@ class Trainer:
             cfg.out_dir, self.logger, rank=self.process_rank,
             shard=jax.process_count() > 1,
             sink=self.exporter.observe if self.exporter else None)
+        # Goodput/badput ledger (obs/goodput.py): constructed FIRST so
+        # its wall-clock t0 covers the whole init (model/data/compile
+        # all land in startup/compile, not in a blind spot). The monitor
+        # is attached below once it exists.
+        self.goodput = None
+        if cfg.obs_goodput:
+            from gtopkssgd_tpu.obs.goodput import GoodputLedger
+            self.goodput = GoodputLedger(
+                metrics=self.metrics,
+                interval=cfg.obs_goodput_interval)
         # Host timeline (obs.timeline): spans + telemetry tracks + event
         # markers as one chrome-trace JSON, written on __exit__ (and
         # best-effort on a watchdog stall). Rank 0 only, like metrics.
@@ -446,11 +478,15 @@ class Trainer:
                     recompile_warmup=cfg.obs_recompile_warmup,
                     mem_leak_windows=cfg.obs_mem_leak_windows,
                     hbm_headroom_frac=cfg.obs_hbm_headroom_frac,
-                    critpath_shift_windows=cfg.obs_critpath_shift_windows),
+                    critpath_shift_windows=cfg.obs_critpath_shift_windows,
+                    goodput_collapse_windows=(
+                        cfg.obs_goodput_collapse_windows)),
                 timeline=self.timeline,
             )
             if cfg.obs_events else None
         )
+        if self.goodput is not None:
+            self.goodput.monitor = self.monitor
         self.watchdog = (
             StallWatchdog(cfg.obs_watchdog,
                           on_stall=self._on_stall,
@@ -625,9 +661,16 @@ class Trainer:
             self.memwatch = MemWatch(
                 metrics=self.metrics, monitor=self.monitor,
                 mem_interval=cfg.obs_mem_interval, logger=self.logger)
+            # Ledger cursor: init-so-far is startup, the AOT pass that
+            # follows is compile (train_started() later picks up the
+            # rest of init as startup).
+            if self.goodput is not None:
+                self.goodput.mark("startup")
             init_compile = self.memwatch.account(
                 self._train_step, self.state, self.carry,
                 self._abstract_batch(), step=0, log=False)
+            if self.goodput is not None:
+                self.goodput.mark("compile")
             if self.memwatch.peak_hbm_bytes is not None:
                 plan_extra["peak_hbm_bytes"] = self.memwatch.peak_hbm_bytes
         # Run-manifest header: first record of every metrics file, so
@@ -813,6 +856,11 @@ class Trainer:
         if not cp:
             return
         self.metrics.log("critpath", flush=True, step=step, **cp)
+        if self.goodput is not None:
+            # The ledger splits step time by the stage shares this
+            # record just measured (compute->goodput, select/comm/wait
+            # ->their badput buckets).
+            self.goodput.note_stage_fracs(cp)
         # AnomalyHalt from the shift rule propagates like any monitor
         # halt — the durable event record lands before the raise.
         if self.monitor is not None:
@@ -925,20 +973,15 @@ class Trainer:
                     self.logger.info("comm-model fit -> %s", path)
             except OSError as e:
                 self.logger.warning("calib artifact write failed: %s", e)
-        if self.cfg.registry and self.cfg.out_dir and self.process_rank == 0:
-            # One summary line per run into the workspace registry
-            # (obs/registry.py) — read back offline with `report
-            # history` / `report regress`.
+        # End-of-run goodput summary (final=1): BEFORE the registry
+        # append below, so the registry line's goodput_frac reads this
+        # run's own decomposition back from the stream.
+        if self.goodput is not None:
             try:
-                from gtopkssgd_tpu.obs import registry as _registry
-                from gtopkssgd_tpu.obs.report import load_records
-                records, _bad = load_records(self.cfg.out_dir)
-                entry = _registry.run_summary(records)
-                if entry is not None:
-                    path = _registry.append_run(self.cfg.registry, entry)
-                    self.logger.info("registry += %s", path)
-            except (OSError, ValueError) as e:
-                self.logger.warning("registry append failed: %s", e)
+                self.goodput.log_record(int(self.state.step), final=True)
+            except Exception as e:
+                self.logger.warning("goodput summary failed: %s", e)
+        self._append_registry()
         if getattr(self, "memwatch", None) is not None:
             self.memwatch.close()
         # The metrics file outlives close() (restore() can resume a closed
@@ -946,6 +989,28 @@ class Trainer:
         self.metrics.close()
         if self.exporter is not None:
             self.exporter.close()
+
+    def _append_registry(self) -> None:
+        """One summary line per run into the workspace registry
+        (obs/registry.py) — read back offline with `report history` /
+        `report regress`. Shared by the normal __exit__ path and the
+        watchdog stall path, so an exit-43 run still leaves its line
+        (with final_status='stalled') like the 44/45 paths do via
+        __exit__. Best-effort: a registry failure never masks the exit
+        it is recording."""
+        if not (self.cfg.registry and self.cfg.out_dir
+                and self.process_rank == 0):
+            return
+        try:
+            from gtopkssgd_tpu.obs import registry as _registry
+            from gtopkssgd_tpu.obs.report import load_records
+            records, _bad = load_records(self.cfg.out_dir)
+            entry = _registry.run_summary(records)
+            if entry is not None:
+                path = _registry.append_run(self.cfg.registry, entry)
+                self.logger.info("registry += %s", path)
+        except (OSError, ValueError) as e:
+            self.logger.warning("registry append failed: %s", e)
 
     # ------------------------------------------------------------ watchdog
     def _stall_diagnostics(self) -> Dict[str, Any]:
@@ -963,12 +1028,31 @@ class Trainer:
     def _on_stall(self, record: Dict[str, Any]) -> None:
         """Persist the diagnostic to metrics.jsonl (line-buffered, so it
         survives the hard exit), then take the default action (stderr dump
-        + os._exit(43))."""
+        + os._exit(43)). Runs on the watchdog thread while the backend is
+        presumed wedged — NOTHING here may touch the device (the stall
+        record's own step stands in for state.step), and os._exit skips
+        __exit__, so the run's registry line and final records must land
+        here or nowhere."""
+        step = record.get("step")
+        step = int(step) if isinstance(step, (int, float)) else 0
         try:
             self.metrics.log("stall", flush=True, **{
                 k: v for k, v in record.items() if k not in ("kind", "time")
             })
+            # The exit-43 equivalents of what finalize_resilience and
+            # __exit__ write on the 44/45 paths: the final_status the
+            # registry line keys on, and the goodput decomposition of
+            # the wall this run DID burn before it wedged.
+            if self.goodput is not None:
+                self.goodput.log_record(step, final=True)
+            self.metrics.log(
+                "recovery", flush=True, action="summary",
+                final_status="stalled", completed=0,
+                n_recoveries=(self.recovery.n_recoveries
+                              if self.recovery is not None else 0),
+                step=step)
             self.metrics.close()
+            self._append_registry()
         except Exception:
             pass
         # Best-effort timeline flush: everything here is host-side, and
@@ -1423,6 +1507,7 @@ class Trainer:
         """Run `num_iters` optimizer steps (reference DLTrainer.train)."""
         cfg = self.cfg
         inj, rec, guard = self.injector, self.recovery, self.preempt
+        gp = self.goodput
         t_start, samples = time.perf_counter(), 0
         last_loss, last_aux = float("nan"), {}
         if num_iters <= 0:
@@ -1450,6 +1535,12 @@ class Trainer:
         wd = self.watchdog
         if wd is not None:
             wd.arm("train", step=step)
+        if gp is not None:
+            # First entry: everything since init not yet attributed is
+            # startup; re-entries (fit()'s later epochs) drop the
+            # inter-epoch span (eval/ckpt marked their own shares; the
+            # rest is honestly `other`).
+            gp.train_started()
         try:
             for _ in range(num_iters // spd if spd > 1 else num_iters):
                 # Preemption flag check at the iteration boundary: the
@@ -1467,6 +1558,10 @@ class Trainer:
                         rec.record("sparse_resume", step=step)
                 if inj is not None:
                     inj.sleep_if_slow(step, step + spd)
+                    if gp is not None:
+                        # Injected slowness is exactly the skew-wait the
+                        # taxonomy's `wait` bucket accounts.
+                        gp.mark("wait")
                 with self.tracer.span("io"):
                     hosts = [self._fetch_host(step, spd)
                              for _ in range(spd)]
@@ -1488,6 +1583,8 @@ class Trainer:
                             host, step, step + spd,
                             axis=2 if spd == 1 else 3)
                     batch = self._device_batch(host)
+                if gp is not None:
+                    gp.mark("data")  # host batch assembly + H2D
                 if rec is not None:
                     # Pre-step snapshot: what a `skip` action restores.
                     # Valid across the dispatch because donation is
@@ -1531,6 +1628,12 @@ class Trainer:
                         )
                 samples += (cfg.batch_size * cfg.nworkers
                             * cfg.nsteps_update * spd)
+                if gp is not None:
+                    # The dispatch span is step time: split by the
+                    # latest critpath stage fractions (all goodput until
+                    # one exists); while degraded, the excess over the
+                    # clean-step EWMA is the degraded-mode delta.
+                    gp.step_mark(begin=True, degraded=self._degraded)
                 step += spd
                 if critpath_now:
                     # Must run BEFORE the calibrator feed — that call
@@ -1539,6 +1642,11 @@ class Trainer:
                                        cleanup=not calib_now)
                 if calib_now:
                     self._feed_calibrator(step, spd, trace_dir)
+                if capture_now and gp is not None:
+                    # Host-side trace attribution is observability
+                    # overhead — no taxonomy bucket; drop it to `other`
+                    # rather than inflate a category it isn't.
+                    gp.mark(None)
                 if inj is not None:
                     # preempt injection delivers a real SIGTERM through
                     # the installed guard; the flag check right after
@@ -1611,6 +1719,11 @@ class Trainer:
                         self.monitor.observe(step, loss=last_loss)
                         observed = True
                     synced = True
+                if gp is not None:
+                    # The obs/log blocking reads drained the dispatched
+                    # step — that wait IS step time, same split as the
+                    # dispatch span (tiny when nothing synced).
+                    gp.step_mark(degraded=self._degraded)
                 if rec is not None:
                     # Apply any actions the monitor's claims queued this
                     # iteration. `step` may rewind (skip/rollback restore
@@ -1637,12 +1750,25 @@ class Trainer:
                     self.memwatch.poll(
                         step, fn=self._train_step,
                         args=(self.state, self.carry, batch))
+                    if gp is not None:
+                        # A never-seen dispatch shape AOT-compiles here;
+                        # warm polls cost ~nothing.
+                        gp.mark("compile")
+                if gp is not None and synced:
+                    # Periodic durable "goodput" record + the
+                    # goodput_collapse feed, at a sync the loop already
+                    # paid. AnomalyHalt propagates AFTER the record is
+                    # durable, like every monitor halt.
+                    gp.tick(step)
             # true_sync, not block_until_ready: the tunneled TPU platform
             # acks readiness before execution completes (utils/timers.py).
             from gtopkssgd_tpu.utils import true_sync
 
             with self.tracer.span("final_sync"):
                 true_sync(self.state.params)
+            if gp is not None:
+                # Draining the last dispatched steps is step time too.
+                gp.step_mark(degraded=self._degraded)
             if wd is not None:
                 wd.heartbeat(step=step)
         finally:
@@ -1730,6 +1856,13 @@ class Trainer:
             out["val_cer"] = float(cer_counts[0] / cer_counts[1])
             out["val_wer"] = float(cer_counts[2] / max(1, cer_counts[3]))
         self.metrics.log("eval", step=int(self.state.step), **out)
+        if self.goodput is not None:
+            # Eval is productive work — the job exists to train AND
+            # measure the model — so it accrues to goodput, not to a
+            # badput bucket (the taxonomy has none for it) and not to
+            # `other` (which must stay an accounting gap, pinned ~0 on
+            # clean runs by the gate smoke).
+            self.goodput.mark("goodput")
         return out
 
     # Space in the 29-char AN4 vocabulary (LABELS = "_'A..Z ") — word
@@ -1825,6 +1958,8 @@ class Trainer:
         every rank-but-0 residual."""
         if self._ckpt is not None:
             self._ckpt.save(int(self.state.step), self.state)
+            if self.goodput is not None:
+                self.goodput.mark("ckpt")
 
     def restore(self) -> bool:
         if self._ckpt is None or self._ckpt.latest_step() is None:
@@ -1851,6 +1986,9 @@ class Trainer:
         # one.
         self._set_iters(step // self.steps_per_epoch,
                         skip_steps=step % self.steps_per_epoch)
+        if self.goodput is not None:
+            # Restore + iterator fast-forward are checkpoint cost.
+            self.goodput.mark("ckpt")
         return True
 
     # ---------------------------------------------------------- resilience
@@ -1864,6 +2002,10 @@ class Trainer:
         step = int(self.state.step)  # blocks: the save must be post-step
         if self._ckpt is not None:
             self._ckpt.save(step, self.state, force=True)
+            if self.goodput is not None:
+                # The emergency save is the preempt fault's designated
+                # badput: ckpt.
+                self.goodput.mark("ckpt")
             self.metrics.log("recovery", flush=True,
                              action="emergency_save", step=step)
             self.logger.warning(
@@ -1892,6 +2034,11 @@ class Trainer:
                 self.state, self.carry = prev_state, prev_carry
                 rec.consecutive_skips += 1
                 step = int(self.state.step)
+                if self.goodput is not None:
+                    # The discarded update's step time was NOT progress:
+                    # reclassify it as wasted (nan_grad's designated
+                    # badput).
+                    self.goodput.wasted_step()
                 rec.record("skip", step, rule,
                            consecutive=rec.consecutive_skips,
                            budget=spec.budget)
@@ -1908,6 +2055,11 @@ class Trainer:
                     time.sleep(wait)
                 self.restore()
                 step = int(self.state.step)
+                if self.goodput is not None:
+                    # restore() marked its own span ckpt (backoff sleep
+                    # included); the rewound step's attribution becomes
+                    # wasted work.
+                    self.goodput.wasted_step()
                 rec.record("rollback", step, rule, backoff_s=wait,
                            use=uses + 1, budget=spec.budget)
             elif spec.action == "degrade":

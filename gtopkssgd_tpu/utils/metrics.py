@@ -89,6 +89,10 @@ KINDS = frozenset({
                    # comm span split into wire vs skew-wait by the
                    # ledger's alpha-beta model; fleet joins these
                    # across ranks into the global critical path
+    "goodput",     # cumulative goodput/badput decomposition
+                   # (obs/goodput.py): per-category seconds summing to
+                   # measured wall (conservation), goodput_frac /
+                   # other_frac, fsync'd every N steps + final summary
 })
 
 _SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")

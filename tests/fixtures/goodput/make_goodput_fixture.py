@@ -1,0 +1,145 @@
+"""Regenerate the committed goodput fixture shards in this directory.
+
+Three rank shards of one synthetic run (shared config_hash), each rank
+10.0 s of wall clock with a hand-chosen badput split (obs/goodput.py
+taxonomy) baked in so every join a test makes is exactly computable:
+
+  rank 0  clean: goodput 8.0 s of 10.0 (select 0.5, comm 0.5, wait 0.2,
+          data 0.3, startup 0.5) -> goodput_frac 0.8, no dominant
+          badput worth naming (select/comm tie broken to "select").
+  rank 1  chaos: a skip and a rollback (recovery records included)
+          wasted 1.5 s over 2 steps, plus a 0.8 s checkpoint ->
+          goodput_frac 0.6, dominant badput "wasted".
+  rank 2  straggler: 4.8 s blocked at collectives (wait) ->
+          goodput_frac 0.4, dominant badput "wait"; its obs records
+          arrive 2.5 s late every step (persistent under the fleet
+          defaults), so straggler rows exist AND carry the goodput
+          column ("wait" at every step).
+
+Fleet joins these to: wall 30.0, goodput 18.0 -> fleet goodput_frac
+0.6; per-rank fracs (0.8, 0.6, 0.4) give median 0.6, so advise() at
+the default margin 0.1 names rank 2, dominant "wait", recoverable
+(0.6 - 0.4) * 10.0 = 2.0 s.
+
+Each rank logs a mid-run cumulative record at step 5 (exactly half of
+every category) and the final record at step 10 — fold() must pick the
+final one. Values are hand-chosen, not sampled, so test assertions are
+exact (see test_goodput.py).
+
+Run from anywhere:  python tests/fixtures/goodput/make_goodput_fixture.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+BASE_TIME = 1700000000.0
+STEP_S = 1.0
+LAG_RANK = 2
+LAG_S = 2.5           # > 2.0 x STEP_S => persistent under the defaults
+CONFIG_HASH = "goodfix0001beef"
+N_RANKS, STEPS = 3, (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
+WALL_S = 10.0
+
+# Per-rank final category seconds; every row sums to WALL_S exactly, so
+# other_s is 0.0 and conservation holds with zero remainder.
+CATEGORY_SECONDS = {
+    0: {"goodput": 8.0, "select": 0.5, "comm": 0.5, "wait": 0.2,
+        "data": 0.3, "startup": 0.5},
+    1: {"goodput": 6.0, "select": 0.4, "comm": 0.4, "wait": 0.2,
+        "ckpt": 0.8, "wasted": 1.5, "data": 0.2, "startup": 0.5},
+    2: {"goodput": 4.0, "select": 0.3, "comm": 0.3, "wait": 4.8,
+        "data": 0.1, "startup": 0.5},
+}
+N_WASTED = {0: 0, 1: 2, 2: 0}
+ALL_CATEGORIES = ("goodput", "select", "comm", "wait", "compile",
+                  "ckpt", "wasted", "degraded", "data", "startup")
+
+
+def manifest(rank: int) -> dict:
+    return {
+        "kind": "manifest", "time": BASE_TIME, "rank": rank,
+        "config_hash": CONFIG_HASH,
+        "dnn": "resnet20", "dataset": "cifar10",
+        "compression": "gtopk", "density": 0.01,
+        "nworkers": N_RANKS, "batch_size": 4, "seed": 42,
+        "num_params": 10000,
+        "process_count": N_RANKS, "process_index": rank,
+        "coordinator_address": "127.0.0.1:9999",
+    }
+
+
+def obs_record(rank: int, step: int) -> dict:
+    lag = LAG_S if rank == LAG_RANK else 0.0
+    return {
+        "kind": "obs", "time": BASE_TIME + step * STEP_S + lag,
+        "rank": rank, "step": step,
+        "loss": round(2.0 - 0.1 * step + 0.01 * rank, 6),
+        "achieved_density": 0.01,
+        "wire_bytes": 2400,
+    }
+
+
+def goodput_record(rank: int, step: int, scale: float,
+                   final: bool) -> dict:
+    """Mirror obs/goodput.py decomposition arithmetic on the hand-chosen
+    seconds (kept inline so the fixture regenerates without importing
+    the package)."""
+    secs = CATEGORY_SECONDS[rank]
+    wall = WALL_S * scale
+    rec = {
+        "kind": "goodput",
+        "time": BASE_TIME + step * STEP_S,
+        "rank": rank, "step": step,
+    }
+    total = 0.0
+    for cat in ALL_CATEGORIES:
+        s = secs.get(cat, 0.0) * scale
+        total += s
+        rec[f"{cat}_s"] = round(s, 6)
+    rec["wall_s"] = round(wall, 6)
+    rec["other_s"] = round(wall - total, 6)
+    rec["goodput_frac"] = round(secs["goodput"] * scale / wall, 6)
+    rec["other_frac"] = round((wall - total) / wall, 6)
+    rec["n_wasted_steps"] = int(round(N_WASTED[rank] * scale))
+    rec["final"] = int(final)
+    rec["source"] = "ledger"
+    return rec
+
+
+def recovery_records(rank: int) -> list:
+    if rank != 1:
+        return []
+    return [
+        {"kind": "recovery", "time": BASE_TIME + 3 * STEP_S,
+         "rank": rank, "step": 3, "action": "skip", "rule": "nan_loss",
+         "consecutive": 1},
+        {"kind": "recovery", "time": BASE_TIME + 6 * STEP_S,
+         "rank": rank, "step": 6, "action": "rollback",
+         "rule": "loss_spike", "restore_step": 5},
+    ]
+
+
+def main() -> None:
+    for rank in range(N_RANKS):
+        path = os.path.join(HERE, f"metrics.rank{rank}.jsonl")
+        with open(path, "w") as fh:
+            fh.write(json.dumps(manifest(rank)) + "\n")
+            recov = {r["step"]: r for r in recovery_records(rank)}
+            for step in STEPS:
+                fh.write(json.dumps(obs_record(rank, step)) + "\n")
+                if step in recov:
+                    fh.write(json.dumps(recov[step]) + "\n")
+                if step == 5:
+                    fh.write(json.dumps(goodput_record(
+                        rank, step, 0.5, final=False)) + "\n")
+            fh.write(json.dumps(goodput_record(
+                rank, STEPS[-1], 1.0, final=True)) + "\n")
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

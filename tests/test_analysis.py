@@ -356,6 +356,45 @@ def test_durable_event_flush_true_passes(tmp_path):
     assert _run(root, "durable-event").findings == []
 
 
+# ------------------------------------------------------------ event-rule
+
+
+EVENTS_FIXTURE = """\
+    RULES = frozenset({"nan_loss", "goodput_collapse"})
+"""
+
+
+def test_event_rule_unregistered_names(tmp_path):
+    # Both static emit-site shapes: the "rule" key of a record dict and
+    # the first argument of a local fire(...) helper.
+    root = _tree(tmp_path, {
+        "pkg/obs/events.py": EVENTS_FIXTURE,
+        "pkg/mod.py": """\
+            def f(fire):
+                ev = {"rule": "tpyo_rule", "severity": "warn"}
+                fire("also_unregistered", step=1)
+                return ev
+        """})
+    res = _run(root, "event-rule")
+    assert [f.rule for f in res.findings] == ["event-rule"] * 2
+    assert "tpyo_rule" in res.findings[0].message
+    assert "also_unregistered" in res.findings[1].message
+
+
+def test_event_rule_registered_and_dynamic_pass(tmp_path):
+    root = _tree(tmp_path, {
+        "pkg/obs/events.py": EVENTS_FIXTURE,
+        "pkg/mod.py": """\
+            def f(fire, name):
+                ev = {"rule": "goodput_collapse", "severity": "warn"}
+                fire("nan_loss", step=1)
+                fire(name, step=2)           # dynamic: runtime _emit's job
+                other = {"rule": name}       # non-constant value: ignored
+                return ev, other
+        """})
+    assert _run(root, "event-rule").findings == []
+
+
 # ------------------------------------------------------- syntax handling
 
 
@@ -385,6 +424,10 @@ def _positive_fixture_for(rule_name):
         "durable-event": {
             "pkg/utils/metrics.py": METRICS_FIXTURE,
             "pkg/mod.py": 'def f(m):\n    m.log("event", what="x")\n'},
+        "event-rule": {
+            "pkg/obs/events.py": EVENTS_FIXTURE,
+            "pkg/mod.py":
+                'def f(fire):\n    fire("nope_rule", step=1)\n'},
     }[rule_name]
 
 

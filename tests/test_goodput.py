@@ -1,0 +1,582 @@
+"""Goodput ledger (gtopkssgd_tpu.obs.goodput): the badput taxonomy, the
+conservation invariant, the live cursor ledger, the offline fold, and
+every surface the decomposition threads through (fleet join, report
+CLI, registry, exporter, timeline, the goodput_collapse rule, and the
+abnormal-exit registry paths).
+
+The unit layer runs on a fake clock and the committed 3-rank fixture
+(tests/fixtures/goodput — regenerate with make_goodput_fixture.py),
+whose category seconds are hand-chosen so every join is exactly
+computable: per-rank goodput_frac (0.8, 0.6, 0.4), fleet 0.6 over 30.0
+rank-seconds, and advise() naming rank 2 ("wait", 2.0 recoverable s).
+The e2e layer drives the real trainer on the canonical 2-way CPU mesh
+config through the 43/44/45 exit paths and asserts each still lands a
+final goodput record and its registry line.
+"""
+
+import json
+import os
+
+import pytest
+
+from gtopkssgd_tpu.obs import goodput as gp
+from gtopkssgd_tpu.obs.events import (
+    RULES,
+    AnomalyHalt,
+    AnomalyMonitor,
+)
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "goodput")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Same model/flags as benchmarks/obs_gate_smoke.py and test_resilience
+# so the e2e runs below reuse the persistent-cache XLA executable.
+CANON = [
+    "--dnn", "resnet20", "--batch-size", "4", "--nworkers", "2",
+    "--compression", "gtopk_layerwise", "--density", "0.01",
+    "--seed", "42", "--eval-batches", "1", "--log-interval", "1",
+    "--obs-interval", "1",
+]
+
+
+def _records(out_dir):
+    path = os.path.join(out_dir, "metrics.jsonl")
+    return [json.loads(line) for line in open(path)]
+
+
+def _fixture_shards():
+    from gtopkssgd_tpu.obs import fleet
+    shards = fleet.resolve_targets([FIXTURE])
+    records_by_rank, bad = fleet.load_shards(shards)
+    assert bad == 0
+    return records_by_rank
+
+
+# ------------------------------------------------------- decomposition
+
+def test_taxonomy_is_closed_and_ordered():
+    assert gp.CATEGORIES[0] == gp.GOODPUT
+    assert gp.CATEGORIES == (gp.GOODPUT,) + gp.BADPUT
+    # "other" is derived, never a category of its own
+    assert "other" not in gp.CATEGORIES
+    assert len(set(gp.CATEGORIES)) == len(gp.CATEGORIES)
+
+
+def test_decomposition_conservation_and_fracs():
+    rec = gp.decomposition({"goodput": 6.0, "wait": 3.0}, 10.0, step=7,
+                           n_wasted_steps=1)
+    assert rec["step"] == 7 and rec["n_wasted_steps"] == 1
+    assert rec["goodput_s"] == 6.0 and rec["wait_s"] == 3.0
+    assert rec["other_s"] == 1.0 and rec["other_frac"] == 0.1
+    assert rec["goodput_frac"] == 0.6
+    assert gp.conservation_error(rec) < 1e-9
+    fr = gp.category_fracs(rec)
+    assert fr["goodput"] == 0.6 and fr["wait"] == 0.3 and fr["other"] == 0.1
+
+
+def test_decomposition_surfaces_negative_other():
+    # Caller double-counting must be VISIBLE (other_s < 0), not clamped.
+    rec = gp.decomposition({"goodput": 8.0, "comm": 4.0}, 10.0)
+    assert rec["other_s"] == -2.0 and rec["other_frac"] == -0.2
+    assert gp.conservation_error(rec) < 1e-9
+
+
+def test_decomposition_zero_wall_is_safe():
+    rec = gp.decomposition({}, 0.0)
+    assert rec["goodput_frac"] == 0.0 and rec["other_frac"] == 0.0
+
+
+def test_dominant_badput_tiebreak_and_none():
+    # select/comm tie -> BADPUT order prefers select; no badput -> None;
+    # a pure accounting gap (other) never wins.
+    assert gp.dominant_badput(
+        {"select_s": 0.5, "comm_s": 0.5, "wall_s": 2.0}) == "select"
+    assert gp.dominant_badput({"goodput_s": 5.0, "other_s": 3.0}) is None
+    assert gp.dominant_badput(
+        {"wait_s": 1.0, "wasted_s": 2.0}) == "wasted"
+
+
+# --------------------------------------------------------- live ledger
+
+def _fake_clock():
+    t = [0.0]
+
+    def clock():
+        return t[0]
+    return t, clock
+
+
+def test_ledger_mark_attributes_spans_once():
+    t, clock = _fake_clock()
+    led = gp.GoodputLedger(clock=clock)
+    t[0] = 1.5
+    assert led.mark("select") == 1.5
+    t[0] = 2.0
+    assert led.mark("comm") == 0.5
+    # zero-width span is a no-op; unknown category raises
+    assert led.mark("select") == 0.0
+    with pytest.raises(ValueError):
+        t[0] = 3.0
+        led.mark("no_such_category")
+    assert led.seconds["select"] == 1.5 and led.seconds["comm"] == 0.5
+    rec = led.snapshot(step=1)
+    assert gp.conservation_error(rec) < 1e-9
+
+
+def test_ledger_train_started_once_then_other():
+    t, clock = _fake_clock()
+    led = gp.GoodputLedger(clock=clock)
+    t[0] = 2.0
+    led.train_started()
+    assert led.seconds["startup"] == 2.0
+    t[0] = 3.0
+    led.train_started()                      # fit() re-entry: not startup
+    assert led.seconds["startup"] == 2.0
+    rec = led.snapshot(step=0)
+    assert rec["other_s"] == 1.0             # the re-entry span
+
+
+def test_ledger_step_split_follows_critpath_fracs():
+    t, clock = _fake_clock()
+    led = gp.GoodputLedger(clock=clock)
+    led.note_stage_fracs({"t_compute_us": 600.0, "t_select_us": 200.0,
+                          "t_comm_wire_us": 100.0, "t_wait_us": 100.0})
+    t[0] = 1.0
+    led.step_mark(begin=True)
+    assert abs(led.seconds["goodput"] - 0.6) < 1e-9
+    assert abs(led.seconds["select"] - 0.2) < 1e-9
+    assert abs(led.seconds["comm"] - 0.1) < 1e-9
+    assert abs(led.seconds["wait"] - 0.1) < 1e-9
+    # a zero-total critpath record is ignored, fracs kept
+    led.note_stage_fracs({"t_compute_us": 0.0})
+    assert led._fracs is not None
+
+
+def test_ledger_step_defaults_to_goodput_without_critpath():
+    t, clock = _fake_clock()
+    led = gp.GoodputLedger(clock=clock)
+    t[0] = 2.0
+    led.step_mark(begin=True)
+    assert led.seconds["goodput"] == 2.0
+
+
+def test_ledger_wasted_step_reclassifies_current_step():
+    t, clock = _fake_clock()
+    led = gp.GoodputLedger(clock=clock)
+    t[0] = 1.0
+    led.step_mark(begin=True)
+    assert led.seconds["goodput"] == 1.0
+    reclassified = led.wasted_step()
+    assert reclassified == 1.0
+    assert led.seconds["goodput"] == 0.0
+    assert led.seconds["wasted"] == 1.0 and led.n_wasted_steps == 1
+    # conservation still holds after the move
+    assert gp.conservation_error(led.snapshot(step=1)) < 1e-9
+
+
+def test_ledger_degraded_charges_only_the_excess():
+    t, clock = _fake_clock()
+    led = gp.GoodputLedger(clock=clock)
+    t[0] = 1.0
+    led.step_mark(begin=True)                # clean step, 1.0 s
+    t[0] = 2.0
+    led.step_mark(begin=True)                # closes it -> EWMA = 1.0
+    assert led._step_ewma == 1.0
+    t[0] = 5.0
+    led.step_mark(begin=True, degraded=True)  # 3.0 s: 2.0 excess
+    assert abs(led.seconds["degraded"] - 2.0) < 1e-9
+    assert abs(led.seconds["goodput"] - 3.0) < 1e-9   # 1 + 1 + clamped 1
+    t[0] = 6.0
+    led.step_mark(begin=True)
+    # the degraded step must NOT have fed the clean-step EWMA
+    assert led._step_ewma == 1.0
+    assert gp.conservation_error(led.snapshot(step=4)) < 1e-9
+
+
+def test_ledger_tick_arms_then_logs_on_cadence():
+    t, clock = _fake_clock()
+    led = gp.GoodputLedger(interval=2, clock=clock)
+    assert led.tick(0) is None               # first tick only arms
+    assert led.tick(1) is None
+    t[0] = 1.0
+    rec = led.tick(2)
+    assert rec is not None and rec["final"] == 0
+    assert led.tick(3) is None               # cadence resets
+    assert gp.GoodputLedger(interval=0, clock=clock).tick(5) is None
+
+
+def test_ledger_log_record_feeds_monitor_except_final():
+    class Mon:
+        def __init__(self):
+            self.calls = []
+
+        def observe_goodput(self, step, *, goodput_frac=None):
+            self.calls.append((step, goodput_frac))
+            return []
+
+    t, clock = _fake_clock()
+    mon = Mon()
+    led = gp.GoodputLedger(monitor=mon, clock=clock)
+    t[0] = 2.0
+    led.mark("goodput")
+    led.log_record(5)
+    led.log_record(7, final=True)            # the run is already ending
+    assert [c[0] for c in mon.calls] == [5]
+    assert mon.calls[0][1] == 1.0
+
+
+# -------------------------------------------------------- offline fold
+
+def test_fold_last_goodput_record_wins():
+    recs = [
+        {"kind": "manifest", "time": 0.0, "rank": 0},
+        {"kind": "goodput", "time": 5.0, "rank": 0, "step": 5,
+         "goodput_s": 2.0, "wall_s": 5.0, "goodput_frac": 0.4,
+         "final": 0},
+        {"kind": "goodput", "time": 10.0, "rank": 0, "step": 10,
+         "goodput_s": 8.0, "wall_s": 10.0, "goodput_frac": 0.8,
+         "final": 1},
+    ]
+    out = gp.fold(recs)
+    assert out["step"] == 10 and out["goodput_frac"] == 0.8
+    assert out["source"] == "ledger"
+    assert "kind" not in out and "time" not in out and "rank" not in out
+
+
+def test_synthesize_from_evidence_records():
+    # manifest at t=100; steps 1..5 at 103..107 (median cadence 1.0);
+    # compile 1.25 s carved out of the 2.0 s startup; one skip priced
+    # at the cadence -> wasted 1.0; the stepped remainder is goodput.
+    recs = [{"kind": "manifest", "time": 100.0}]
+    recs += [{"kind": "obs", "step": s, "time": 102.0 + s}
+             for s in range(1, 6)]
+    recs.append({"kind": "compile", "lower_s": 0.5, "compile_s": 0.75})
+    recs.append({"kind": "recovery", "action": "skip", "step": 3})
+    out = gp.synthesize(recs)
+    assert out["source"] == "folded" and out["final"] == 1
+    assert out["wall_s"] == 7.0
+    assert abs(out["compile_s"] - 1.25) < 1e-6
+    assert abs(out["startup_s"] - 0.75) < 1e-6
+    assert abs(out["wasted_s"] - 1.0) < 1e-6 and out["n_wasted_steps"] == 1
+    assert abs(out["goodput_s"] - 4.0) < 1e-6
+    assert gp.conservation_error(out) < 1e-6
+    # no timed steps at all -> nothing to synthesize
+    assert gp.synthesize([{"kind": "manifest", "time": 1.0}]) is None
+    assert gp.fold([{"kind": "manifest", "time": 1.0}]) is None
+
+
+# ---------------------------------------------- fixture joins (exact)
+
+def test_fixture_fold_shards_exact_decompositions():
+    decomp = gp.fold_shards(_fixture_shards())
+    assert sorted(decomp) == [0, 1, 2]
+    assert [decomp[r]["goodput_frac"] for r in (0, 1, 2)] == [0.8, 0.6, 0.4]
+    assert [gp.dominant_badput(decomp[r]) for r in (0, 1, 2)] == \
+        ["select", "wasted", "wait"]
+    for r in (0, 1, 2):
+        assert decomp[r]["wall_s"] == 10.0
+        assert decomp[r]["other_s"] == 0.0
+        assert decomp[r]["final"] == 1       # the final record won
+        assert gp.conservation_error(decomp[r]) < 1e-9
+    assert decomp[1]["n_wasted_steps"] == 2
+    assert decomp[1]["ckpt_s"] == 0.8
+    assert decomp[2]["wait_s"] == 4.8
+
+
+def test_fixture_fleet_decomposition_is_wall_weighted():
+    decomp = gp.fold_shards(_fixture_shards())
+    fleet_rec = gp.fleet_decomposition(decomp)
+    assert fleet_rec["n_ranks"] == 3
+    assert fleet_rec["wall_s"] == 30.0
+    assert fleet_rec["goodput_s"] == 18.0
+    assert fleet_rec["goodput_frac"] == 0.6
+    assert fleet_rec["n_wasted_steps"] == 2
+    assert fleet_rec["source"] == "fleet"
+    assert gp.fleet_decomposition({}) is None
+
+
+def test_fixture_advise_names_the_straggler():
+    decomp = gp.fold_shards(_fixture_shards())
+    hint = gp.advise(decomp)
+    assert hint["rank"] == 2
+    assert hint["goodput_frac"] == 0.4
+    assert hint["fleet_median_frac"] == 0.6
+    assert hint["dominant_badput"] == "wait"
+    assert abs(hint["recoverable_s"] - 2.0) < 1e-6
+    # healthy fleet (everyone within margin) and single rank -> None
+    assert gp.advise({0: decomp[0], 1: decomp[0]}) is None
+    assert gp.advise({2: decomp[2]}) is None
+
+
+def test_format_goodput_renders_table_bars_compare_hint():
+    decomp = gp.fold_shards(_fixture_shards())
+    fleet_rec = gp.fleet_decomposition(decomp)
+    clean = {0: decomp[0]}
+    text = gp.format_goodput(decomp, fleet=fleet_rec, compare=clean,
+                             hint=gp.advise(decomp))
+    assert "r2 goodput [" in text and "worst badput: wait" in text
+    assert "fleet (3 ranks): goodput 60.0%" in text
+    assert "vs compare run" in text
+    assert "advise: evict/replace rank 2" in text
+    assert "~2.0 rank-seconds" in text
+    empty = gp.format_goodput({})
+    assert "no goodput decomposition" in empty
+
+
+def test_fleet_merge_carries_goodput_and_straggler_badput():
+    from gtopkssgd_tpu.obs import fleet
+
+    merged = fleet.merge([FIXTURE])
+    rows = merged["goodput"]
+    assert [r["rank"] for r in rows] == [0, 1, 2]
+    assert [r["badput"] for r in rows] == ["select", "wasted", "wait"]
+    assert all(r["src"] == "goodput" for r in rows)
+    assert merged["goodput_fleet"]["goodput_frac"] == 0.6
+    # the straggler table's badput column: rank 2 is the slowest rank
+    # at every step, and its decomposition says WHERE the time goes
+    stragglers = merged["stragglers"]
+    assert stragglers and all(
+        r["slowest_rank"] == 2 for r in stragglers)
+    assert all(r["badput"] == "wait" for r in stragglers)
+    assert all(abs(r["badput_frac"] - 0.48) < 1e-6 for r in stragglers)
+    # the 2.5 s lag (> 2.0 x the 1.0 s cadence) goes persistent after
+    # the monitor's warmup
+    assert any(r["persistent"] for r in stragglers)
+
+
+def test_report_goodput_cli_on_fixture(capsys):
+    from gtopkssgd_tpu.obs import report
+
+    assert report.main(["goodput", FIXTURE, "--advise"]) == 0
+    out = capsys.readouterr().out
+    assert "goodput: ranks=[0, 1, 2]" in out
+    assert "advise: evict/replace rank 2" in out
+
+
+def test_report_goodput_cli_empty_and_missing(tmp_path, capsys):
+    from gtopkssgd_tpu.obs import report
+
+    # a shard with a manifest but nothing to fold or synthesize -> 1
+    bare = tmp_path / "bare"
+    bare.mkdir()
+    (bare / "metrics.jsonl").write_text(json.dumps(
+        {"kind": "manifest", "time": 1.0, "rank": 0,
+         "config_hash": "x"}) + "\n")
+    assert report.main(["goodput", str(bare)]) == 1
+    # unreadable target -> usage contract 2
+    assert report.main(["goodput", str(tmp_path / "missing")]) == 2
+
+
+# ------------------------------------------------- registry & exporter
+
+def test_registry_summary_and_regress_pin_goodput_frac():
+    from gtopkssgd_tpu.obs import registry
+
+    shards = _fixture_shards()
+    entry = registry.run_summary(shards[1])
+    assert entry is not None
+    assert entry["stats"]["goodput_frac"] == 0.6
+    assert entry["stats"]["other_frac"] == 0.0
+    rows = registry.history_rows([entry])
+    assert rows and rows[0][registry.HISTORY_HEADER.index("goodput")] \
+        == "0.6000"
+    # the regress check: +-0.10 absolute on goodput_frac
+    assert ("goodput_frac", 0.0, 0.10) in registry.REGRESS_CHECKS
+    base = {"stats": {"goodput_frac": 0.9}}
+    ok = {"stats": {"goodput_frac": 0.85}}
+    bad = {"stats": {"goodput_frac": 0.7}}
+    _, failures = registry.regress(ok, base)
+    assert failures == 0
+    _, failures = registry.regress(bad, base)
+    assert failures == 1
+
+
+def test_exporter_serves_goodput_gauges():
+    from gtopkssgd_tpu.obs.exporter import MetricsExporter
+
+    ex = MetricsExporter(port=0)
+    ex.observe({"kind": "goodput", "rank": 1, "goodput_frac": 0.8,
+                "wait_s": 0.25, "wall_s": 10.0, "source": "ledger"})
+    body = ex.scrape()
+    assert "# TYPE gtopk_goodput_goodput_frac gauge" in body
+    assert 'gtopk_goodput_goodput_frac{rank="1",source="ledger"} 0.8' \
+        in body
+    assert "gtopk_goodput_wait_s" in body
+
+
+def test_timeline_gains_badput_track():
+    from gtopkssgd_tpu.obs.timeline import timeline_from_records
+
+    records = [json.loads(line) for line in
+               open(os.path.join(FIXTURE, "metrics.rank1.jsonl"))]
+    doc = timeline_from_records(records)
+    counters = [ev for ev in doc["traceEvents"] if ev.get("ph") == "C"]
+    goodput_counters = [ev for ev in counters if ev["name"] == "goodput"]
+    badput_counters = [ev for ev in counters if ev["name"] == "badput_s"]
+    assert goodput_counters and badput_counters
+    assert goodput_counters[-1]["args"]["goodput_frac"] == 0.6
+    # the stacked badput counter carries every nonzero category
+    assert badput_counters[-1]["args"]["wasted"] == 1.5
+
+
+# -------------------------------------------------- goodput_collapse
+
+def test_goodput_collapse_warmup_fire_and_rearm():
+    m = AnomalyMonitor()
+    assert m.observe_goodput(1, goodput_frac=0.8) == []
+    assert m.observe_goodput(2, goodput_frac=0.8) == []      # warmup
+    assert m.observe_goodput(3, goodput_frac=0.1) == []      # streak 1
+    assert m.observe_goodput(4, goodput_frac=0.1) == []      # streak 2
+    fired = m.observe_goodput(5, goodput_frac=0.1)           # streak 3
+    assert [ev["rule"] for ev in fired] == ["goodput_collapse"]
+    assert fired[0]["severity"] == "warn" and fired[0]["step"] == 5
+    # re-armed: the very next collapsed record does not re-fire
+    assert m.observe_goodput(6, goodput_frac=0.1) == []
+    assert m.summary()["goodput_collapse"] == 1
+
+
+def test_goodput_collapse_recovery_resets_streak():
+    m = AnomalyMonitor()
+    for step, frac in ((1, 0.8), (2, 0.8), (3, 0.1), (4, 0.1)):
+        assert m.observe_goodput(step, goodput_frac=frac) == []
+    # a recovered record resets the below-threshold streak
+    assert m.observe_goodput(5, goodput_frac=0.8) == []
+    assert m.observe_goodput(6, goodput_frac=0.1) == []
+    assert m.observe_goodput(7, goodput_frac=0.1) == []
+    assert m._gp_streak == 2                 # rebuilt from zero
+    # non-finite fractions are ignored entirely
+    assert m.observe_goodput(8, goodput_frac=None) == []
+    assert m._gp_streak == 2
+
+
+def test_goodput_collapse_honors_halt_on_warn():
+    m = AnomalyMonitor(halt_on="warn")
+    for step, frac in ((1, 0.8), (2, 0.8), (3, 0.1), (4, 0.1)):
+        m.observe_goodput(step, goodput_frac=frac)
+    with pytest.raises(AnomalyHalt) as ei:
+        m.observe_goodput(5, goodput_frac=0.1)
+    assert ei.value.event["rule"] == "goodput_collapse"
+
+
+def test_emit_rejects_unregistered_rule():
+    assert "goodput_collapse" in RULES
+    m = AnomalyMonitor()
+    with pytest.raises(ValueError, match="unregistered anomaly rule"):
+        m._emit([{"rule": "not_a_rule", "severity": "warn", "step": 1}])
+
+
+# ------------------------------------------------------------ doc drift
+
+def test_readme_event_table_covers_registered_rules():
+    """The README event table and obs.events.RULES must be the same
+    set — a rule added without documentation (or a documented rule that
+    no longer exists) fails tier-1, not review."""
+    import re
+
+    readme = open(os.path.join(REPO, "README.md"), encoding="utf-8").read()
+    documented = set(re.findall(
+        r"^\s*\|\s*`(\w+)`\s*\|\s*(?:warn|error)\s*\|", readme,
+        flags=re.MULTILINE))
+    assert documented == set(RULES), (
+        f"README event table drifted from obs.events.RULES: "
+        f"undocumented={sorted(set(RULES) - documented)} "
+        f"stale={sorted(documented - set(RULES))}")
+
+
+# --------------------------------------- abnormal-exit registry paths
+# Satellite contract: every abnormal exit (43 stall / 44 halt / 45
+# preempt) still lands the run's final goodput record AND its registry
+# line, with the right final_status.
+
+def _registry_entries(reg_dir):
+    path = os.path.join(reg_dir, "runs.jsonl")
+    return [json.loads(line) for line in open(path)]
+
+
+def test_halt_exit_path_appends_registry_line(tmp_path):
+    """Unclaimed NaN with --obs-halt-on error -> exit 44; the run's
+    registry line says 'halted' and carries the goodput stats from the
+    final ledger record __exit__ wrote on the way down."""
+    from gtopkssgd_tpu import dist_trainer
+    from gtopkssgd_tpu.obs import HALT_EXIT_CODE
+
+    out = str(tmp_path / "run")
+    reg = str(tmp_path / "registry")
+    rc = dist_trainer.main(CANON + [
+        "--num-iters", "4", "--inject", "nan_grad@2",
+        "--obs-halt-on", "error", "--registry", reg, "--out-dir", out])
+    assert rc == HALT_EXIT_CODE
+    finals = [r for r in _records(out) if r["kind"] == "goodput"
+              and r.get("final")]
+    assert len(finals) == 1
+    assert gp.conservation_error(finals[0]) < 1e-6
+    entries = _registry_entries(reg)
+    assert len(entries) == 1
+    assert entries[0]["stats"]["final_status"] == "halted"
+    assert entries[0]["stats"]["goodput_frac"] == \
+        finals[0]["goodput_frac"]
+
+
+@pytest.mark.slow  # a second full dist_trainer run beyond the halt one
+def test_preempt_exit_path_appends_registry_line(tmp_path):
+    """Injected SIGTERM -> emergency save -> exit 45; same contract."""
+    from gtopkssgd_tpu import dist_trainer
+    from gtopkssgd_tpu.resilience import PREEMPT_EXIT_CODE
+
+    out = str(tmp_path / "run")
+    reg = str(tmp_path / "registry")
+    rc = dist_trainer.main(CANON + [
+        "--num-iters", "4", "--inject", "preempt@2",
+        "--registry", reg, "--out-dir", out])
+    assert rc == PREEMPT_EXIT_CODE
+    finals = [r for r in _records(out) if r["kind"] == "goodput"
+              and r.get("final")]
+    assert len(finals) == 1 and finals[0]["ckpt_s"] > 0
+    entries = _registry_entries(reg)
+    assert len(entries) == 1
+    assert entries[0]["stats"]["final_status"] == "preempted"
+    assert entries[0]["stats"]["goodput_frac"] == \
+        finals[0]["goodput_frac"]
+
+
+def test_stall_exit_path_appends_registry_line(tmp_path, monkeypatch):
+    """The watchdog path cannot run __exit__ (os._exit skips it), so
+    _on_stall itself must land the stall record, the final goodput
+    record, the 'stalled' summary, and the registry line. Driven by
+    calling the trainer's stall hook directly with the hard-exit
+    half neutered — the real firing condition is pinned in test_obs."""
+    import gtopkssgd_tpu.trainer as trainer_mod
+    from gtopkssgd_tpu.trainer import TrainConfig, Trainer
+
+    exits = []
+    monkeypatch.setattr(trainer_mod, "_default_on_stall",
+                        lambda record: exits.append(record))
+    out = str(tmp_path / "run")
+    reg = str(tmp_path / "registry")
+    cfg = TrainConfig(
+        dnn="resnet20", batch_size=4, nworkers=2,
+        compression="gtopk_layerwise", density=0.01, seed=42,
+        log_interval=1, obs_interval=1, eval_batches=1, max_epochs=1,
+        out_dir=out, registry=reg)
+    with Trainer(cfg) as t:
+        t.train(2)
+        t._on_stall({"kind": "stall", "step": 2, "armed_phase":
+                     "dispatch", "stalled_s": 12.5})
+        assert len(exits) == 1               # would have os._exit(43)'d
+    recs = _records(out)
+    stalls = [r for r in recs if r["kind"] == "stall"]
+    assert len(stalls) == 1 and stalls[0]["stalled_s"] == 12.5
+    finals = [r for r in recs if r["kind"] == "goodput"
+              and r.get("final")]
+    assert len(finals) == 1 and finals[0]["step"] == 2
+    assert gp.conservation_error(finals[0]) < 1e-6
+    summaries = [r for r in recs if r["kind"] == "recovery"
+                 and r.get("action") == "summary"]
+    assert summaries and summaries[-1]["final_status"] == "stalled"
+    # _on_stall closed metrics and appended its line; the context exit
+    # above must not have crashed on the closed logger (its own append
+    # re-reads the same stream, so every entry agrees on the status)
+    entries = _registry_entries(reg)
+    assert entries and all(
+        e["stats"]["final_status"] == "stalled" for e in entries)
