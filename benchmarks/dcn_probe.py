@@ -321,6 +321,41 @@ def main():
     }
     if timings.get("sweep"):
         report["alpha_beta_fit"] = fit_alpha_beta(timings["sweep"])
+        # Axis-keyed form of the same fit, in the calib-artifact "axes"
+        # schema (obs/calib.py write_artifact): a localhost probe only
+        # crosses the process boundary — the slow "dcn" hop — so the
+        # honest section carries exactly that one axis. ledger.
+        # load_alpha_beta prefers axis-keyed artifacts at equal P, and
+        # planner_inputs prices the dcn hop from this entry.
+        fit = report["alpha_beta_fit"]
+        report["axes"] = {"dcn": {
+            "alpha_ms": fit["alpha_ms"],
+            "beta_gbps": fit["beta_gbps"],
+            "n_samples": len(fit["points"]),
+            "identifiable": "alpha_beta",
+        }}
+
+    # Per-round rows: the deterministic round -> (src, dst, axis) join
+    # of the gtopk merge tree at this P (obs/linkmap.py), with rank 0's
+    # measured gtopk span carved per round in proportion to the modeled
+    # wire time — the probe-side seed of the link weather map.
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from gtopkssgd_tpu.obs import linkmap as _linkmap
+    mine = _linkmap.rank_rounds(
+        _linkmap.round_peers("gtopk", args.procs), 0)
+    fit = report.get("alpha_beta_fit", {})
+    weights = _linkmap.round_weights(
+        mine, sparse_bytes,
+        alpha_ms=fit.get("alpha_ms") or 0.1,
+        beta_gbps=fit.get("beta_gbps") or max(eff_gbps, 1e-9))
+    carved = _linkmap.carve_rounds(report["gtopk_ms"], weights)
+    report["round_rows"] = [
+        {"round": rd["round"], "axis": rd["axis"], "phase": rd["phase"],
+         "src": rd["src"], "dst": rd["dst"],
+         "link": _linkmap.link_key(rd["axis"], rd["src"], rd["dst"]),
+         "t_ms": round(t, 4)}
+        for rd, t in zip(mine, carved)]
 
     # Re-emit the projection with the measured cross-process constant as
     # the DCN bandwidth so the curve has one real anchor point on it.

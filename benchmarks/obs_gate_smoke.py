@@ -62,6 +62,7 @@ SMOKES = {
     "mem": ("mem",),
     "critpath": ("critpath",),
     "goodput": ("goodput",),
+    "linkmap": ("linkmap",),
     "lint": ("lint",),
 }
 # Sub-smokes a selected one cannot run without: the plan A/B reuses the
@@ -877,6 +878,147 @@ def run_goodput_smoke(out_dir: str) -> dict:
     }
 
 
+def run_linkmap_smoke(out_dir: str) -> dict:
+    """Link-level weather-map smoke (the linkmap tentpole's consumer):
+    a clean and a slow-link leg of a SYNTHETIC p=4 gtopk tree fleet —
+    no trainer, no timing noise, so the baseline can pin the carve,
+    the fleet merge, and the degradation rule exactly. Every rank runs
+    its own LinkMap writing a real per-rank shard
+    (metrics.rank{r}.jsonl), exactly the layout ``report linkmap``
+    merges in production. Returns the fields the main run logs as ONE
+    "linkmap" record:
+
+      clean leg (4 windows)      every rank observes its exactly-modeled
+                                 span, so after the carve every link's
+                                 EWMA is identical: clean_max_dev_x
+                                 (max |vs_median - 1| over the merged
+                                 rows) is exactly 0, n_links is the
+                                 tree's 4 distinct pairs, and
+                                 ``report linkmap`` exits 0 — the
+                                 no-false-positive pin
+      slow leg (6 windows)       the degraded pair comes from the SAME
+                                 resilience grammar production uses:
+                                 parse_inject("slow_rank:2:...") names
+                                 rank 2, and the slow link is the pair
+                                 (2, 2^1)=(2,3) — both endpoints of a
+                                 slow link measure the stall, so both
+                                 ranks' spans are inflated. The carve
+                                 spreads each rank's inflation over its
+                                 2 rounds, the endpoint-mean merge
+                                 concentrates it on dcn:2-3 (t0+d/2 vs
+                                 t0+d/4 on the adjacent pairs), so the
+                                 fleet-median rule must name EXACTLY
+                                 the injected pair: slow_worst_src=2,
+                                 slow_worst_dst=3 (atol 0). Feeding the
+                                 merged map to an AnomalyMonitor at
+                                 x=1.5/windows=3 with halt_on=warn must
+                                 fire link_degraded on window 3 and
+                                 halt — with the event record already
+                                 durable in the shard (slow_fired,
+                                 durable_before_halt, halt_exit_ok all
+                                 exactly 1)
+
+    Everything here is deterministic arithmetic (synthetic spans, exact
+    carve, EWMA of a constant stream), so the baseline pins the ratio
+    fields tight and the indicator fields exact."""
+    from gtopkssgd_tpu.obs import linkmap as _linkmap
+    from gtopkssgd_tpu.obs import report
+    from gtopkssgd_tpu.obs.events import (AnomalyHalt, AnomalyMonitor,
+                                          HALT_EXIT_CODE, Thresholds)
+    from gtopkssgd_tpu.resilience.inject import parse_inject
+    from gtopkssgd_tpu.utils.metrics import MetricsLogger
+
+    p, wire_mode = 4, "gtopk"
+    wire = 400_000.0
+    delay_ms = 50.0
+
+    def _modeled_span(rank: int) -> float:
+        mine = _linkmap.rank_rounds(
+            _linkmap.round_peers(wire_mode, p), rank)
+        return sum(_linkmap.round_weights(mine, wire))
+
+    def _fleet_ewma(maps: dict) -> dict:
+        merged: dict = {}
+        for lm in maps.values():
+            for key, v in lm.ewma_by_link().items():
+                merged.setdefault(key, []).append(v)
+        return {k: sum(vs) / len(vs) for k, vs in merged.items()}
+
+    # ---- clean leg: exactly-modeled spans, zero deviation expected.
+    clean_dir = os.path.join(out_dir, "linkmap_clean")
+    loggers = {r: MetricsLogger(out_dir=clean_dir, rank=r, shard=True)
+               for r in range(p)}
+    maps = {r: _linkmap.LinkMap(wire_mode, p, rank=r,
+                                metrics=loggers[r])
+            for r in range(p)}
+    for step in range(1, 5):
+        for rank, lm in maps.items():
+            lm.observe(step, t_comm_ms=_modeled_span(rank),
+                       wire_bytes=wire)
+    for log in loggers.values():
+        log.close()
+    clean_recs, _ = report.load_records(clean_dir)
+    clean_sum = _linkmap.summarize_linkmap(clean_recs)
+    clean_max_dev = max(
+        (abs(float(r["vs_median_x"]) - 1.0) for r in clean_sum["rows"]
+         if isinstance(r.get("vs_median_x"), (int, float))),
+        default=-1.0)
+    clean_rc = report.run_linkmap([clean_dir])
+
+    # ---- slow leg: the injected pair, the fleet rule, the halt.
+    fault = parse_inject("slow_rank:2:0.05s@1-6")[0]
+    slow_rank = int(fault.args[0])
+    slow_peer = slow_rank ^ 1
+    slow_dir = os.path.join(out_dir, "linkmap_slow")
+    loggers = {r: MetricsLogger(out_dir=slow_dir, rank=r, shard=True)
+               for r in range(p)}
+    maps = {r: _linkmap.LinkMap(wire_mode, p, rank=r,
+                                metrics=loggers[r])
+            for r in range(p)}
+    mon = AnomalyMonitor(
+        thresholds=Thresholds(link_degraded_x=1.5,
+                              link_degraded_windows=3),
+        metrics=loggers[0], halt_on="warn")
+    halted = 0.0
+    try:
+        for step in range(1, 7):
+            for rank, lm in maps.items():
+                t = _modeled_span(rank)
+                if rank in (slow_rank, slow_peer):
+                    t += delay_ms
+                lm.observe(step, t_comm_ms=t, wire_bytes=wire)
+            mon.observe_links(step, _fleet_ewma(maps))
+    except AnomalyHalt:
+        halted = float(HALT_EXIT_CODE == 44)
+    for log in loggers.values():
+        log.close()
+    ev = next((e for e in mon.events if e["rule"] == "link_degraded"),
+              None)
+    slow_recs, _ = report.load_records(slow_dir)
+    durable = any(r.get("kind") == "event"
+                  and r.get("rule") == "link_degraded"
+                  for r in slow_recs)
+    slow_sum = _linkmap.summarize_linkmap(slow_recs)
+    worst = slow_sum.get("worst") or {}
+    slow_rc = report.run_linkmap([slow_dir])
+    lo, hi = sorted((slow_rank, slow_peer))
+    return {
+        "clean_rc": float(clean_rc),
+        "clean_links": float(clean_sum["n_links"]),
+        "clean_max_dev_x": round(float(clean_max_dev), 6),
+        "slow_fired": float(ev is not None),
+        "slow_halted": halted,
+        "durable_before_halt": float(durable),
+        "slow_worst_src": float(worst.get("src", -1)),
+        "slow_worst_dst": float(worst.get("dst", -1)),
+        "slow_worst_is_injected_pair": float(
+            worst.get("src") == lo and worst.get("dst") == hi),
+        "slow_vs_median_x": (round(float(ev["value"]), 6)
+                             if ev else -1.0),
+        "slow_report_rc": float(slow_rc),
+    }
+
+
 def run_smoke(out_dir: str, only=None) -> str:
     """Train the canonical run; returns the run dir (metrics.jsonl inside).
 
@@ -929,6 +1071,8 @@ def run_smoke(out_dir: str, only=None) -> str:
                if _selected("mem", only) else None)
     goodput_rec = (run_goodput_smoke(out_dir)
                    if _selected("goodput", only) else None)
+    linkmap_rec = (run_linkmap_smoke(out_dir)
+                   if _selected("linkmap", only) else None)
     critpath_rec = critpath_real = None
     if _selected("critpath", only):
         critpath_rec, critpath_real = run_critpath_smoke(out_dir)
@@ -1022,6 +1166,13 @@ def run_smoke(out_dir: str, only=None) -> str:
         # the final record durable first). Durable -> flush=True.
         if goodput_rec is not None:
             t.metrics.log("goodput", flush=True, **goodput_rec)
+        # And the linkmap smoke: the clean fleet's zero-deviation pin
+        # (no false positives), the slow leg naming exactly the
+        # injected pair (slow_rank inject grammar -> worst link), and
+        # the link_degraded fire/halt contract with the event record
+        # durable before the raise. Durable evidence -> flush=True.
+        if linkmap_rec is not None:
+            t.metrics.log("linkmap", flush=True, **linkmap_rec)
         # And the critical-path smoke: one REAL per-step stage-interval
         # record from the overlap arm (so the registry's wait_frac /
         # crit_stage_modal path runs on gate data) plus the summary the

@@ -529,7 +529,8 @@ class DurableEventRule:
     name = "durable-event"
 
     DURABLE_KINDS = {"event", "inject", "recovery", "calib", "regress",
-                     "compile", "overlap", "critpath", "goodput"}
+                     "compile", "overlap", "critpath", "goodput",
+                     "linkmap"}
 
     def run(self, files: Sequence[SourceFile]) -> List[Finding]:
         findings: List[Finding] = []

@@ -252,6 +252,27 @@ reference's only telemetry was text logs):
                                          goodput_collapse anomaly fires
                                          (default 3; honors
                                          --obs-halt-on like every rule)
+    --obs-linkmap / --no-obs-linkmap     per-(axis, peer) network
+                                         weather map (obs.linkmap):
+                                         carve each calibration
+                                         capture's measured comm span
+                                         over the schedule's
+                                         round->peer join, keep EWMA
+                                         latency/bandwidth per link,
+                                         log one durable 'linkmap'
+                                         record per capture. Needs
+                                         --obs-calib (rides its
+                                         cadence); default off.
+                                         Inspect with 'report linkmap'
+    --obs-link-degraded-x X              one link's EWMA latency above
+                                         X times the fleet median
+                                         counts as a degraded window
+                                         (default 4.0)
+    --obs-link-degraded-windows K        consecutive degraded windows
+                                         before the link_degraded
+                                         anomaly fires (default 3; a
+                                         recovered window re-arms;
+                                         honors --obs-halt-on)
     --registry DIR                       append one summary line per run
                                          to DIR/runs.jsonl (obs.registry:
                                          manifest header + steps/sec,
@@ -561,6 +582,23 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="consecutive ledger records with goodput_frac "
                         "below half its own EWMA before goodput_collapse "
                         "fires (honors --obs-halt-on)")
+    p.add_argument("--obs-linkmap", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="per-(axis, peer) network weather map "
+                        "(obs.linkmap): carve each calibration capture's "
+                        "measured comm span over the schedule's "
+                        "round->peer join into per-link EWMA latency/"
+                        "bandwidth, one durable 'linkmap' record per "
+                        "capture, feeding the link_degraded rule. Needs "
+                        "--obs-calib (rides its cadence); inspect with "
+                        "'report linkmap'")
+    p.add_argument("--obs-link-degraded-x", type=float, default=4.0,
+                   help="one link's EWMA latency above this multiple of "
+                        "the fleet median counts as a degraded window")
+    p.add_argument("--obs-link-degraded-windows", type=int, default=3,
+                   help="consecutive degraded windows before "
+                        "link_degraded fires (a recovered window "
+                        "re-arms; honors --obs-halt-on)")
     p.add_argument("--registry", default=None, metavar="DIR",
                    help="append this run's summary line (manifest subset "
                         "+ steps/sec, comm ratio, fitted alpha/beta, "
@@ -672,6 +710,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         obs_goodput=args.obs_goodput,
         obs_goodput_interval=args.obs_goodput_interval,
         obs_goodput_collapse_windows=args.obs_goodput_collapse_windows,
+        obs_linkmap=args.obs_linkmap,
+        obs_link_degraded_x=args.obs_link_degraded_x,
+        obs_link_degraded_windows=args.obs_link_degraded_windows,
         registry=args.registry,
         comm_model_fit=args.comm_model_fit,
         inject=args.inject,

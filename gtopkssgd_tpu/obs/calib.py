@@ -49,6 +49,11 @@ from gtopkssgd_tpu.obs.ledger import (
     DEFAULT_DCN_GBPS,
     _tree_rounds_fallback,
 )
+from gtopkssgd_tpu.obs import linkmap as _linkmap
+
+# ICI fallback bandwidth for the per-axis split/fit baseline (same
+# value parallel/planner.py prices un-measured ici hops with).
+_DEFAULT_ICI_GBPS = 1600.0
 
 # bytes -> ms conversion at 1 Gbps: t_ms = bytes * 8 / (beta_gbps * 1e9)
 # * 1e3 = bytes * _MS_PER_BYTE_AT_1GBPS / beta_gbps.
@@ -183,10 +188,12 @@ class CommCalibrator:
                  metrics=None, monitor=None,
                  refit_interval: int = 4, min_samples: int = 4,
                  fit_window: int = _FIT_WINDOW,
-                 max_samples: int = 4096):
+                 max_samples: int = 4096, ici_size: int = 1):
         self.wire_mode = str(wire_mode)
         self.p = int(p)
-        self.msgs = message_count(self.wire_mode, self.p)
+        self.ici_size = max(1, int(ici_size))
+        self.msgs = message_count(self.wire_mode, self.p,
+                                  ici_size=self.ici_size)
         self.baseline = dict(baseline) if baseline else {}
         self.metrics = metrics
         self.monitor = monitor
@@ -196,6 +203,19 @@ class CommCalibrator:
         self.max_samples = max(self.fit_window, int(max_samples))
         # (msgs, wire_bytes, t_comm_ms) triples, oldest first.
         self.samples: List[Tuple[int, float, float]] = []
+        # Per-axis sample pools: each blended sample is split per mesh
+        # axis by the weather map's proportional carve (the rank-0 view
+        # of the schedule — symmetric for the modes we run), so hier's
+        # ici and dcn hops accumulate SEPARATE (msgs, bytes, t) pools
+        # and refit/write_artifact can price each hop from its own
+        # measured fit. For single-axis modes the "dcn" pool mirrors
+        # the blended one (and its fit matches the blended fit).
+        self._axis_rounds = _linkmap.rank_rounds(
+            _linkmap.round_peers(self.wire_mode, self.p,
+                                 ici_size=self.ici_size), 0)
+        self.axis_samples: Dict[str, List[Tuple[int, float, float]]] = {}
+        # Last per-axis refit fits, keyed by axis name.
+        self.axis_fits: Dict[str, Dict[str, Any]] = {}
         # Samples measured under an OVERLAPPED pipeline, kept apart:
         # their t_comm is the exposed (partially hidden) span, so the
         # per-message alpha-beta inversion does not hold for them —
@@ -236,11 +256,65 @@ class CommCalibrator:
         self.samples.append((m, float(wire_bytes), float(t_comm_ms)))
         if len(self.samples) > self.max_samples:
             del self.samples[:len(self.samples) - self.max_samples]
+        self._split_axes(m, float(wire_bytes), float(t_comm_ms))
         self._pending += 1
         if (self._pending >= self.refit_interval
                 and len(self.samples) >= self.min_samples):
             return self.refit(step)
         return None
+
+    def _split_axes(self, msgs: int, wire_bytes: float,
+                    t_comm_ms: float) -> None:
+        """Split one blended sample per mesh axis via the weather map's
+        proportional carve and append to the per-axis pools. The axis
+        message count scales with any caller msgs override (bucketed
+        runs launch B merges per sample)."""
+        mine = self._axis_rounds
+        if not mine:
+            return
+        weights = _linkmap.round_weights(
+            mine, wire_bytes,
+            beta_gbps=(self.baseline.get("beta_gbps")
+                       or DEFAULT_DCN_GBPS),
+            ici_gbps=(self.baseline.get("ici_gbps")
+                      or _DEFAULT_ICI_GBPS))
+        carved = _linkmap.carve_rounds(t_comm_ms, weights)
+        per_round_bytes = wire_bytes / len(mine)
+        scale = msgs / self.msgs if self.msgs > 0 else 1.0
+        agg: Dict[str, List[float]] = {}
+        for rd, t_ms in zip(mine, carved):
+            a = agg.setdefault(rd["axis"], [0.0, 0.0, 0.0])
+            a[0] += 1.0
+            a[1] += per_round_bytes
+            a[2] += t_ms
+        for axis, (n_rounds, b, t) in agg.items():
+            pool = self.axis_samples.setdefault(axis, [])
+            pool.append((max(1, round(n_rounds * scale)), b, t))
+            if len(pool) > self.max_samples:
+                del pool[:len(pool) - self.max_samples]
+
+    def _fit_axes(self, window: Optional[int]
+                  ) -> Dict[str, Dict[str, Any]]:
+        """Per-axis alpha/beta fits over the newest ``window`` samples
+        of each pool (None = all). Only axes whose pool supports a fit
+        appear; ici pools fall back to the ici baseline bandwidth when
+        the slope is unidentifiable."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for axis in sorted(self.axis_samples):
+            pool = self.axis_samples[axis]
+            if window is not None:
+                pool = pool[-window:]
+            if len(pool) < self.min_samples:
+                continue
+            base_beta = (
+                (self.baseline.get("ici_gbps") or _DEFAULT_ICI_GBPS)
+                if axis == _linkmap.AXIS_ICI
+                else (self.baseline.get("beta_gbps")
+                      or DEFAULT_DCN_GBPS))
+            fit = fit_alpha_beta(pool, baseline_beta_gbps=base_beta)
+            if fit is not None:
+                out[axis] = fit
+        return out
 
     def refit(self, step: int) -> Optional[Dict[str, Any]]:
         """Fit over the newest window, log the ``calib`` record
@@ -276,6 +350,15 @@ class CommCalibrator:
             rec["drift_alpha_x"] = round(da, 6)
         if db is not None:
             rec["drift_beta_x"] = round(db, 6)
+        # Per-axis fits ride the same record under dotted keys (the
+        # registry flattens them as alpha_ms.<axis> stats): for hier
+        # this prices the ici and dcn hops separately; for single-axis
+        # modes the dcn fit mirrors the blended one.
+        self.axis_fits = self._fit_axes(self.fit_window)
+        for axis, axfit in sorted(self.axis_fits.items()):
+            rec[f"alpha_ms.{axis}"] = round(axfit["alpha_ms"], 6)
+            rec[f"beta_gbps.{axis}"] = round(axfit["beta_gbps"], 6)
+            rec[f"n_samples.{axis}"] = axfit["n_samples"]
         if self.startup_fit is None:
             self.startup_fit = dict(fit)
         else:
@@ -305,6 +388,11 @@ class CommCalibrator:
             self.samples,
             baseline_beta_gbps=(self.baseline.get("beta_gbps")
                                 or DEFAULT_DCN_GBPS))
+
+    def final_axis_fits(self) -> Dict[str, Dict[str, Any]]:
+        """Per-axis fits over every retained sample — the artifact's
+        ``axes`` section."""
+        return self._fit_axes(None)
 
     def write_artifact(self, out_dir: str, *,
                        manifest: Optional[Mapping[str, Any]] = None,
@@ -344,6 +432,23 @@ class CommCalibrator:
                          "normalization (obs/calib.py)"),
             },
         }
+        # Per-axis section (ici/dcn today, arbitrary axis names later):
+        # ledger.load_alpha_beta surfaces it and planner_inputs prices
+        # hier's two hops from the two measured fits instead of the
+        # blended one. Only axes with a usable fit appear.
+        axes = {}
+        for axis, axfit in sorted(self.final_axis_fits().items()):
+            axes[axis] = {
+                "alpha_ms": round(axfit["alpha_ms"], 4),
+                "beta_gbps": (round(axfit["beta_gbps"], 3)
+                              if axfit["beta_gbps"] > 1e-3
+                              else axfit["beta_gbps"]),
+                "n_samples": axfit["n_samples"],
+                "resid_ms": round(axfit["resid_ms"], 6),
+                "identifiable": axfit["identifiable"],
+            }
+        if axes:
+            payload["axes"] = axes
         os.makedirs(out_dir, exist_ok=True)
         path = os.path.join(out_dir, f"calib_fit_{procs}proc.json")
         tmp = path + ".tmp"

@@ -81,6 +81,19 @@ synced (no extra device reads):
                                   Fed by the GoodputLedger's periodic
                                   durable records through
                                   ``observe_goodput``
+  link_degraded         warn      one link's EWMA latency in the
+                                  weather map (obs/linkmap.py) stayed
+                                  above ``link_degraded_x`` x the
+                                  fleet-median link EWMA for
+                                  ``link_degraded_windows`` CONSECUTIVE
+                                  observations — a specific (axis,
+                                  peer-pair) hop degraded, not just
+                                  "some rank is slow". The streak IS
+                                  the warmup (no single-window fire);
+                                  fires once per streak, then re-arms.
+                                  Fed by LinkMap.observe through
+                                  ``observe_links`` AFTER the durable
+                                  linkmap record is written
 
 Every rule name is registered in the module-level ``RULES`` frozenset
 (the event-plane mirror of ``utils/metrics.KINDS``): ``_emit`` rejects
@@ -128,6 +141,8 @@ RULES = frozenset({
     "hbm_headroom",          # bytes_in_use near bytes_limit
     "critpath_shift",        # global critical stage moved
     "goodput_collapse",      # goodput_frac fell off its own EWMA
+    "link_degraded",         # one (axis, peer) link's EWMA pulled away
+                             # from the fleet median (obs/linkmap.py)
 })
 
 
@@ -181,6 +196,13 @@ class Thresholds:
     goodput_warmup: int = 2          # ledger records before the
                                      # collapse rule arms (early-run
                                      # fractions are startup-dominated)
+    link_degraded_x: float = 4.0     # a link's EWMA latency vs the
+                                     # fleet-median link EWMA above
+                                     # which a window counts as degraded
+    link_degraded_windows: int = 3   # consecutive degraded windows
+                                     # before link_degraded fires (the
+                                     # streak is the rule's warmup —
+                                     # one noisy window never fires)
 
     def age_max(self, rho: Optional[float]) -> float:
         if self.residual_age_max > 0:
@@ -271,6 +293,10 @@ class AnomalyMonitor:
         self._gp_ewma: Optional[float] = None
         self._gp_n = 0
         self._gp_streak = 0
+        # Link-plane state (observe_links): per-link consecutive
+        # degraded-window streaks. A link leaving the offender set
+        # drops its streak entirely (re-arm on recovery).
+        self._link_streaks: Dict[str, int] = {}
 
     # ---------------------------------------------------------- the rules
     def _check(self, step: int, loss: Optional[float],
@@ -566,6 +592,63 @@ class AnomalyMonitor:
         self._gp_n += 1
         return out
 
+    # ------------------------------------------------- link plane (linkmap)
+    def _check_links(self, step: int, ewma_ms_by_link: Dict[str, float]
+                     ) -> List[Dict[str, Any]]:
+        th = self.th
+        out: List[Dict[str, Any]] = []
+        finite = {str(k): float(v) for k, v in ewma_ms_by_link.items()
+                  if _finite(v)}
+        # A one-link map has no fleet to compare against (worst == only
+        # == median); the rule needs at least two links to mean anything.
+        if len(finite) < 2:
+            self._link_streaks.clear()
+            return out
+        vals = sorted(finite.values())
+        mid = len(vals) // 2
+        median = (vals[mid] if len(vals) % 2
+                  else 0.5 * (vals[mid - 1] + vals[mid]))
+        if median <= 0:
+            return out
+        offenders = {k: v for k, v in finite.items()
+                     if v > th.link_degraded_x * median}
+        # Recovery re-arms: a link back under the threshold loses its
+        # streak entirely, so the NEXT degradation starts from zero.
+        for key in list(self._link_streaks):
+            if key not in offenders:
+                del self._link_streaks[key]
+        for key in sorted(offenders):
+            v = offenders[key]
+            n = self._link_streaks.get(key, 0) + 1
+            self._link_streaks[key] = n
+            if n < th.link_degraded_windows or out:
+                continue  # streak still building, or already firing once
+            # Fire once per streak, then re-arm this link: a SUSTAINED
+            # degradation fires again only after another full streak.
+            self._link_streaks[key] = 0
+            axis, _, pair = key.partition(":")
+            lo, _, hi = pair.partition("-")
+            ev = {
+                "rule": "link_degraded", "severity": "warn", "step": step,
+                "value": round(v / median, 6),
+                "threshold": round(th.link_degraded_x, 6),
+                "link": key, "axis": axis,
+                "ewma_ms": round(v, 6),
+                "fleet_median_ms": round(median, 6),
+                "windows": n,
+                "message": (f"link {key} EWMA {v:.4g} ms stayed above "
+                            f"{th.link_degraded_x:g} x the fleet median "
+                            f"{median:.4g} ms for {n} consecutive "
+                            "windows — that hop degraded, not just "
+                            "'some rank is slow'"),
+            }
+            try:
+                ev["src"], ev["dst"] = int(lo), int(hi)
+            except ValueError:
+                pass
+            out.append(ev)
+        return out
+
     # ------------------------------------------------------------- public
     def _emit(self, fired: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         """Record, persist (fsync'd), mark on the timeline, and — after
@@ -672,6 +755,16 @@ class AnomalyMonitor:
         record BEFORE feeding the monitor, so the decomposition that
         explains the collapse survives the exit-44 halt."""
         return self._emit(self._check_goodput(step, goodput_frac))
+
+    def observe_links(self, step: int, ewma_ms_by_link: Dict[str, float]
+                      ) -> List[Dict[str, Any]]:
+        """Evaluate the link_degraded rule against one weather-map
+        snapshot: {link key ("axis:lo-hi") -> EWMA latency ms} from
+        LinkMap (obs/linkmap.py). Same emit/halt contract as observe —
+        LinkMap writes its durable linkmap record BEFORE calling this,
+        so the evidence naming the degraded hop survives the exit-44
+        halt."""
+        return self._emit(self._check_links(step, dict(ewma_ms_by_link)))
 
     def summary(self) -> Dict[str, int]:
         """{rule: count} over the monitor's lifetime (test/report aid)."""

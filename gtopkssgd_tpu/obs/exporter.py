@@ -26,6 +26,7 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
@@ -83,6 +84,12 @@ class MetricsExporter:
         # {(family, labels-tuple): value}; insertion order groups scrapes.
         self._samples: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
                             float] = {}
+        # family -> wall time of its newest observation. The
+        # latest-value store serves stale gauges forever (a dead rank
+        # looks healthy on scrape); the per-family
+        # ``<prefix>_scrape_age_seconds`` gauge derived from this map
+        # is how a scraper tells "fresh" from "fossil".
+        self._family_seen: Dict[str, float] = {}
         self._n_records = 0
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -108,20 +115,51 @@ class MetricsExporter:
             elif isinstance(val, str):
                 labels.append((_sanitize(key), val[:_MAX_LABEL_LEN]))
         label_key = tuple(sorted(labels))
+        stamp = rec.get("time")
+        stamp = (float(stamp) if isinstance(stamp, (int, float))
+                 and math.isfinite(stamp) else time.time())
+        # Weather-map records additionally fan out their per-link list
+        # into <prefix>_link_* families with (link, axis, src, dst)
+        # labels — the scrapeable form of `report linkmap`.
+        link_samples = []
+        if kind == "linkmap" and isinstance(rec.get("links"), list):
+            for link in rec["links"]:
+                if not isinstance(link, dict):
+                    continue
+                link_labels = tuple(sorted(
+                    [("rank", str(rec.get("rank", 0)))]
+                    + [(name, str(link.get(name, ""))[:_MAX_LABEL_LEN])
+                       for name in ("link", "axis", "src", "dst")]))
+                for field in ("ewma_ms", "ewma_gbps", "n"):
+                    val = link.get(field)
+                    if isinstance(val, (int, float)) and math.isfinite(val):
+                        link_samples.append(
+                            (f"{self.prefix}_link_{_sanitize(field)}",
+                             link_labels, float(val)))
         with self._lock:
             self._n_records += 1
             for field, val in numeric.items():
                 family = f"{self.prefix}_{_sanitize(kind)}_{_sanitize(field)}"
                 self._samples[(family, label_key)] = val
+                self._family_seen[family] = stamp
+            for family, lk, val in link_samples:
+                self._samples[(family, lk)] = val
+                self._family_seen[family] = stamp
 
     # ------------------------------------------------------------- expose
-    def scrape(self) -> str:
+    def scrape(self, now: Optional[float] = None) -> str:
         """The OpenMetrics exposition body (also what GET /metrics
         serves): `# TYPE` line per family, samples grouped under it,
-        terminated by `# EOF`."""
+        terminated by `# EOF`. Every family additionally gets a
+        ``<prefix>_scrape_age_seconds{family=...}`` gauge — seconds
+        since its newest observation — because the latest-value store
+        otherwise serves a dead rank's last gauges forever and it looks
+        healthy. ``now`` overrides the clock (tests)."""
         with self._lock:
             samples = dict(self._samples)
+            seen = dict(self._family_seen)
             n = self._n_records
+        now = time.time() if now is None else float(now)
         by_family: Dict[str, list] = {}
         for (family, labels), val in samples.items():
             by_family.setdefault(family, []).append((labels, val))
@@ -129,6 +167,14 @@ class MetricsExporter:
         meta_family = f"{self.prefix}_exporter_records_observed"
         lines.append(f"# TYPE {meta_family} gauge")
         lines.append(f"{meta_family} {n}")
+        if seen:
+            age_family = f"{self.prefix}_scrape_age_seconds"
+            lines.append(f"# TYPE {age_family} gauge")
+            for family in sorted(seen):
+                age = max(0.0, now - seen[family])
+                lines.append(
+                    f'{age_family}{{family="{_escape_label(family)}"}} '
+                    f"{_fmt_value(age)}")
         for family in sorted(by_family):
             lines.append(f"# TYPE {family} gauge")
             for labels, val in sorted(by_family[family]):

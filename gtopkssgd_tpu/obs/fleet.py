@@ -315,6 +315,44 @@ def goodput_rows(records_by_rank: Mapping[int, List[dict]]
     return rows, decomp_by_rank, fleet
 
 
+def _linkmap_by_rank(records_by_rank: Mapping[int, List[dict]]
+                     ) -> Dict[int, List[dict]]:
+    """{rank: [linkmap records sorted by step]} — each rank's weather-
+    map snapshots (possibly empty)."""
+    out: Dict[int, List[dict]] = {}
+    for rank, records in records_by_rank.items():
+        recs = [r for r in records if r.get("kind") == "linkmap"
+                and isinstance(r.get("step"), (int, float))
+                and not isinstance(r.get("step"), bool)]
+        if recs:
+            recs.sort(key=lambda r: float(r["step"]))
+            out[rank] = recs
+    return out
+
+
+def _slow_link_at(lm_recs: Optional[List[dict]], step: float
+                  ) -> Tuple[Optional[str], Optional[float]]:
+    """(worst link key, its EWMA-over-fleet-median factor) from the
+    straggling rank's latest weather-map record at or before ``step``
+    (falling back to its first record when the straggler row predates
+    the first capture). (None, None) when the rank shipped no linkmap
+    records — pre-linkmap shards merge unchanged."""
+    if not lm_recs:
+        return None, None
+    rec = lm_recs[0]
+    for cand in lm_recs:
+        if float(cand["step"]) <= step:
+            rec = cand
+        else:
+            break
+    link = rec.get("worst_link")
+    if not isinstance(link, str) or not link:
+        return None, None
+    x = rec.get("worst_over_median_x")
+    return link, (float(x) if isinstance(x, (int, float))
+                  and not isinstance(x, bool) else None)
+
+
 def straggler_rows(records_by_rank: Mapping[int, List[dict]],
                    kind: Optional[str] = None,
                    monitor: Optional[AnomalyMonitor] = None
@@ -327,7 +365,10 @@ def straggler_rows(records_by_rank: Mapping[int, List[dict]],
     (GC pause, one slow input batch). ``monitor`` carries the EWMA state
     and the ``straggler_persistent`` rule — pass the trainer's monitor
     (halt_on set) to make a persistent straggler fail fast; the default
-    records only.
+    records only. When the slowest rank shipped ``linkmap`` records,
+    the row also carries its dominant slow link (``slow_link`` /
+    ``slow_link_x``) — the difference between "rank 2 is late" and
+    "rank 2 is late and its dcn hop to rank 5 is 4x the fleet median".
     """
     kind = kind or pick_straggler_kind(records_by_rank)
     if kind is None:
@@ -341,6 +382,9 @@ def straggler_rows(records_by_rank: Mapping[int, List[dict]],
     # WHERE that host's lost time goes — wait vs wasted vs ckpt — which
     # is the column ``report goodput --advise`` reasons from.
     gp_idx = _goodput_by_rank(records_by_rank)
+    # And its dominant slow link (from its ``linkmap`` weather-map
+    # records, when it shipped any): WHICH hop is dragging that host.
+    lm_idx = _linkmap_by_rank(records_by_rank)
     by_step = _arrival_times(records_by_rank, kind)
     steps = sorted(by_step)
     med_arrivals = [_median(list(by_step[s].values())) for s in steps]
@@ -361,6 +405,7 @@ def straggler_rows(records_by_rank: Mapping[int, List[dict]],
         fired = monitor.events[events_before:]
         crec = crit_idx.get(("critpath", step), {}).get(slowest) or {}
         badput, badput_frac = _badput_at(gp_idx.get(slowest), step)
+        slow_link, slow_link_x = _slow_link_at(lm_idx.get(slowest), step)
         rows.append({
             "src": kind, "step": step, "field": "straggler",
             "n_ranks": len(times),
@@ -373,6 +418,8 @@ def straggler_rows(records_by_rank: Mapping[int, List[dict]],
             "stage": crec.get("crit_stage"),
             "badput": badput,
             "badput_frac": badput_frac,
+            "slow_link": slow_link,
+            "slow_link_x": slow_link_x,
         })
     return rows, list(monitor.events)
 

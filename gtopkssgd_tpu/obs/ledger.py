@@ -191,17 +191,55 @@ def _fit_artifact_key(path: str):
             os.path.basename(path))
 
 
+def _parse_fit_artifact(path: str) -> Optional[Dict[str, Any]]:
+    """{alpha_ms, beta_gbps, source[, axes]} from one fit artifact, or
+    None when unreadable/unusable. The optional ``axes`` section maps
+    axis name -> per-axis fit ({"ici": {...}, "dcn": {...}} today,
+    arbitrary mesh-axis names later); only axes with numeric alpha_ms
+    and beta_gbps > 0 survive parsing."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    fit = doc.get("alpha_beta_fit") or {}
+    alpha, beta = fit.get("alpha_ms"), fit.get("beta_gbps")
+    if not (isinstance(alpha, (int, float))
+            and isinstance(beta, (int, float)) and beta > 0):
+        return None
+    out: Dict[str, Any] = {"alpha_ms": float(alpha),
+                           "beta_gbps": float(beta),
+                           "source": os.path.basename(path)}
+    axes = doc.get("axes")
+    if isinstance(axes, dict):
+        clean: Dict[str, Dict[str, float]] = {}
+        for name, ax in axes.items():
+            if (isinstance(ax, dict)
+                    and isinstance(ax.get("alpha_ms"), (int, float))
+                    and isinstance(ax.get("beta_gbps"), (int, float))
+                    and ax["beta_gbps"] > 0):
+                clean[str(name)] = {"alpha_ms": float(ax["alpha_ms"]),
+                                    "beta_gbps": float(ax["beta_gbps"])}
+        if clean:
+            out["axes"] = clean
+    return out
+
+
 def load_alpha_beta(search_dir: Optional[str] = None,
                     nprocs: Optional[int] = None
-                    ) -> Optional[Dict[str, float]]:
+                    ) -> Optional[Dict[str, Any]]:
     """The fitted {alpha_ms, beta_gbps} from a fit artifact —
     ``dcn_probe_{n}proc.json`` (benchmarks/dcn_probe.py) or
     ``calib_fit_{n}proc.json`` (obs/calib.py, the in-run calibrator) —
     or None. ``nprocs`` restricts to that exact proc count; otherwise
     the largest proc count present wins (closest to a real fleet), with
-    proc counts compared numerically. At equal proc count a calib_fit
-    outranks a dcn_probe (the calibrator measured the actual workload's
-    collectives; the probe measured synthetic point-to-point pings).
+    proc counts compared numerically. At equal proc count an artifact
+    carrying a per-axis ``axes`` section outranks an axis-blind one
+    (two measured hops price a hierarchical plan better than one
+    blended fit — same spirit as the calib-over-probe rule), then a
+    calib_fit outranks a dcn_probe (the calibrator measured the actual
+    workload's collectives; the probe measured synthetic pings). The
+    returned dict carries the ``axes`` section through when present.
     Default search dir: benchmarks/results/."""
     if search_dir is None:
         repo = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -215,18 +253,16 @@ def load_alpha_beta(search_dir: Optional[str] = None,
             glob.glob(os.path.join(search_dir, "dcn_probe_*proc.json"))
             + glob.glob(os.path.join(search_dir, "calib_fit_*proc.json")),
             key=_fit_artifact_key, reverse=True)
+    best_key, best = None, None
     for path in paths:
-        try:
-            with open(path) as fh:
-                fit = json.load(fh).get("alpha_beta_fit") or {}
-        except (OSError, json.JSONDecodeError):
+        parsed = _parse_fit_artifact(path)
+        if parsed is None:
             continue
-        alpha, beta = fit.get("alpha_ms"), fit.get("beta_gbps")
-        if isinstance(alpha, (int, float)) and isinstance(
-                beta, (int, float)) and beta > 0:
-            return {"alpha_ms": float(alpha), "beta_gbps": float(beta),
-                    "source": os.path.basename(path)}
-    return None
+        p_key, calib_key, name = _fit_artifact_key(path)
+        key = (p_key, 1 if "axes" in parsed else 0, calib_key, name)
+        if best_key is None or key > best_key:
+            best_key, best = key, parsed
+    return best
 
 
 def _manifest_params(manifest: Optional[Mapping[str, Any]]

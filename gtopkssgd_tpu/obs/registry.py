@@ -208,6 +208,14 @@ def run_summary(records: Sequence[Dict[str, Any]]
             stats["alpha_ms"] = last_calib["alpha_fit_ms"]
         if _finite(last_calib.get("beta_fit_gbps")):
             stats["beta_gbps"] = last_calib["beta_fit_gbps"]
+        # Per-axis fits ride the calib record under dotted keys
+        # (alpha_ms.dcn, beta_gbps.ici, ...); carry them verbatim so
+        # regress can pin each measured hop, not just the blend.
+        for field in sorted(last_calib):
+            if ((field.startswith("alpha_ms.")
+                 or field.startswith("beta_gbps."))
+                    and _finite(last_calib[field])):
+                stats[field] = last_calib[field]
     if recall_floor is not None:
         stats["recall_floor"] = round(float(recall_floor), 6)
     if wire_n:
@@ -301,6 +309,14 @@ def history_rows(entries: Sequence[Dict[str, Any]],
         if config_hash and e.get("config_hash") != config_hash:
             continue
         stats = e.get("stats") or {}
+        # Compact per-axis fit cell: "dcn:21.9/2.1 ici:0.1/1600" —
+        # alpha_ms/beta_gbps per measured axis; "-" pre-linkmap.
+        ax_names = sorted({f.split(".", 1)[1] for f in stats
+                           if f.startswith(("alpha_ms.", "beta_gbps."))})
+        axes_cell = " ".join(
+            f"{a}:{_cell(stats.get('alpha_ms.' + a))}"
+            f"/{_cell(stats.get('beta_gbps.' + a))}"
+            for a in ax_names) or "-"
         rows.append([
             str(e.get("config_hash", "?"))[:16],
             str(e.get("git_sha", "?"))[:10],
@@ -310,6 +326,7 @@ def history_rows(entries: Sequence[Dict[str, Any]],
             _cell(stats.get("mean_comm_ratio")),
             _cell(stats.get("alpha_ms")),
             _cell(stats.get("beta_gbps")),
+            axes_cell,
             _cell(stats.get("recall_floor")),
             _cell(stats.get("wire_bytes_per_step")),
             _cell(stats.get("peak_hbm_bytes")),
@@ -326,10 +343,10 @@ def history_rows(entries: Sequence[Dict[str, Any]],
 
 
 HISTORY_HEADER = ["config", "git", "steps", "steps/s", "loss",
-                  "comm_ratio", "alpha_ms", "beta_gbps", "recall",
-                  "wireB/step", "peak_hbm", "recomp", "pipeline", "B",
-                  "ovl_frac", "crit_stage", "wait_frac", "goodput",
-                  "status"]
+                  "comm_ratio", "alpha_ms", "beta_gbps", "axes",
+                  "recall", "wireB/step", "peak_hbm", "recomp",
+                  "pipeline", "B", "ovl_frac", "crit_stage",
+                  "wait_frac", "goodput", "status"]
 
 
 def pick_baseline(entry: Dict[str, Any],
@@ -362,7 +379,16 @@ def regress(entry: Dict[str, Any], baseline: Dict[str, Any]
     base = baseline.get("stats") or {}
     rows: List[List[str]] = []
     failures = 0
-    for field, rtol, atol in REGRESS_CHECKS:
+    # Per-axis alpha/beta stats (alpha_ms.<axis> / beta_gbps.<axis>,
+    # from the calibrator's per-axis fits) are dynamic — the axis names
+    # are the mesh's, not ours — so pin every one present on either
+    # side at the same 2x rtol the blended fit gets: a silently
+    # degraded hop fails the cross-run gate like any other field.
+    axis_checks = tuple(
+        (field, 1.00, 0.0)
+        for field in sorted(set(cur) | set(base))
+        if field.startswith(("alpha_ms.", "beta_gbps.")))
+    for field, rtol, atol in REGRESS_CHECKS + axis_checks:
         have_cur, have_base = _finite(cur.get(field)), _finite(
             base.get(field))
         if not have_cur and not have_base:

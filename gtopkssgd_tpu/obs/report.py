@@ -25,6 +25,9 @@ or gate one against a committed baseline.
                                                         # (--advise, --compare)
     python -m gtopkssgd_tpu.obs.report watch <run>...   # live tail-follow
     python -m gtopkssgd_tpu.obs.report ledger <run>...  # comm model vs measured
+    python -m gtopkssgd_tpu.obs.report linkmap <run>... # per-(axis, peer)
+                                                        # network weather map +
+                                                        # per-axis calib fits
     python -m gtopkssgd_tpu.obs.report history <dir>    # registry trend table
                                                         # (obs/registry.py)
     python -m gtopkssgd_tpu.obs.report regress <run> --registry <dir>
@@ -923,7 +926,8 @@ def run_watch(targets: Sequence[str], interval: float = 2.0,
     import sys
     out = out or sys.stdout
 
-    # rank -> [path, offset, n_records, n_bad, last_rec_by_kind]
+    # rank -> [path, offset, n_records, n_bad, last_rec_by_kind,
+    #          last_two_record_times]
     state: Dict[int, list] = {}
 
     def discover():
@@ -933,11 +937,11 @@ def run_watch(targets: Sequence[str], interval: float = 2.0,
                         os.path.join(target, "metrics.jsonl")]:
                     r = shard_rank(path)
                     state.setdefault(r if r is not None else 0,
-                                     [path, 0, 0, 0, {}])
+                                     [path, 0, 0, 0, {}, []])
             else:
                 r = shard_rank(target)
                 state.setdefault(r if r is not None else 0,
-                                 [target, 0, 0, 0, {}])
+                                 [target, 0, 0, 0, {}, []])
 
     n_polls = 0
     try:
@@ -958,6 +962,10 @@ def run_watch(targets: Sequence[str], interval: float = 2.0,
                 st[3] += bad
                 for rec in recs:
                     st[4][str(rec.get("kind"))] = rec
+                    ts = rec.get("time")
+                    if isinstance(ts, (int, float)):
+                        st[5].append(float(ts))
+                        del st[5][:-2]
             stamp = _time.strftime("%H:%M:%S")
             print(f"watch @ {stamp}  ({len(state)} rank(s))", file=out)
             # Live straggler view: each rank's latest per-step record
@@ -980,7 +988,8 @@ def run_watch(targets: Sequence[str], interval: float = 2.0,
                 med_arrival = (vals[mid] if len(vals) % 2
                                else 0.5 * (vals[mid - 1] + vals[mid]))
             for rank in sorted(state):
-                path, _, n, bad, last = state[rank]
+                path, _, n, bad, last = state[rank][:5]
+                times = state[rank][5]
                 latest = None
                 for kind in ("train", "obs", "eval"):
                     if kind in last:
@@ -1016,6 +1025,26 @@ def run_watch(targets: Sequence[str], interval: float = 2.0,
                                 "recompile_count"):
                         if isinstance(mem.get(key), (int, float)):
                             bits.append(f"{key}={_fmt(mem[key])}")
+                lm = last.get("linkmap")
+                if lm is not None and lm.get("worst_link"):
+                    # the rank's slowest peer hop (latest weather-map
+                    # record) and how far it sits above its link median.
+                    x = lm.get("worst_over_median_x")
+                    bits.append(
+                        f"slowest_peer={lm['worst_link']}"
+                        + (f"({_fmt(x)}x)"
+                           if isinstance(x, (int, float)) else ""))
+                if times:
+                    # freshness: seconds since the shard's newest record;
+                    # STALE once the gap exceeds 3x the rank's own log
+                    # cadence (last inter-record interval) — a wedged or
+                    # dead rank keeps serving its last gauges otherwise.
+                    age = max(0.0, _time.time() - times[-1])
+                    bits.append(f"age_s={_fmt(age)}")
+                    cadence = (times[-1] - times[-2]
+                               if len(times) >= 2 else None)
+                    if cadence and cadence > 0 and age > 3 * cadence:
+                        bits.append("STALE")
                 ev = last.get("event")
                 if ev is not None:
                     bits.append(f"last_event={ev.get('rule')}")
@@ -1108,6 +1137,35 @@ def run_ledger(targets: Sequence[str], json_out: Optional[str] = None,
             fh.write("\n")
         print(f"wrote {json_out}")
     return 0
+
+
+def run_linkmap(targets: Sequence[str],
+                json_out: Optional[str] = None) -> int:
+    """``linkmap`` subcommand: join one or many runs' per-rank
+    "linkmap" records into the fleet network weather map — per-(axis,
+    peer) EWMA latency/bandwidth with endpoint averaging, the worst
+    link vs the fleet median, and the per-axis calib fit lines when the
+    stream carries dotted per-axis calib fields."""
+    from gtopkssgd_tpu.obs import linkmap as _linkmap
+
+    records = []
+    for target in targets:
+        try:
+            recs, bad = load_records(target)
+        except OSError as e:
+            print(f"cannot read {target}: {e}")
+            return 2
+        if bad:
+            print(f"note: {target}: skipped {bad} malformed line(s)")
+        records.extend(recs)
+    summary = _linkmap.summarize_linkmap(records)
+    print(_linkmap.format_linkmap(summary))
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump(summary, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0 if summary["rows"] else 1
 
 
 def _fit_provenance_line(records: Iterable[dict]) -> Optional[str]:
@@ -1810,6 +1868,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_ledger(a.targets, json_out=a.json_out,
                           alpha_ms=a.alpha_ms, beta_gbps=a.beta_gbps,
                           probe_dir=a.probe_dir)
+    if argv and argv[0] == "linkmap":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report linkmap",
+            description="Join per-rank linkmap records into the fleet "
+                        "network weather map: per-(axis, peer) EWMA "
+                        "latency/bandwidth, worst link vs fleet median, "
+                        "per-axis calib fits (obs/linkmap.py).")
+        ap.add_argument("targets", nargs="+",
+                        help="run dirs or record files (fleet dirs ok)")
+        ap.add_argument("--json", dest="json_out", default=None)
+        a = ap.parse_args(argv[1:])
+        return run_linkmap(a.targets, json_out=a.json_out)
     if argv and argv[0] == "history":
         ap = argparse.ArgumentParser(
             "gtopkssgd_tpu.obs.report history",

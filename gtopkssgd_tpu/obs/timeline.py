@@ -219,6 +219,47 @@ def timeline_from_records(records: List[dict],
                         "args": {"step": step, "critical": critical,
                                  "crit_stage": res.get("crit_stage")},
                     })
+    # ---- per-link lanes: each linkmap record's carved per-round
+    # intervals (obs/linkmap.py) become duration events on one lane per
+    # (axis, peer-pair) link, anchored like the critpath lanes so the
+    # observing rank's comm window ends at the record's wall time — the
+    # Perfetto view of WHICH hop each round's time went to.
+    link_tids: Dict[str, int] = {}
+    for rec in records:
+        if (rec.get("kind") != "linkmap"
+                or not isinstance(rec.get("time"), (int, float))
+                or not isinstance(rec.get("rounds"), list)):
+            continue
+        rounds = [rd for rd in rec["rounds"]
+                  if isinstance(rd, dict)
+                  and isinstance(rd.get("t_ms"), (int, float))
+                  and not isinstance(rd.get("t_ms"), bool)]
+        total_us = sum(float(rd["t_ms"]) for rd in rounds) * 1e3
+        t_cursor = float(rec["time"]) * 1e6 - total_us
+        for rd in rounds:
+            dur = float(rd["t_ms"]) * 1e3
+            try:
+                lo, hi = sorted((int(rd.get("src")), int(rd.get("dst"))))
+            except (TypeError, ValueError):
+                t_cursor += dur
+                continue
+            key = f"{rd.get('axis', '?')}:{lo}-{hi}"
+            tid = link_tids.get(key)
+            if tid is None:
+                tid = link_tids[key] = 200 + len(link_tids)
+                events.append({
+                    "ph": "M", "name": "thread_name", "pid": 0,
+                    "tid": tid, "args": {"name": f"link {key}"}})
+            if dur > 0:
+                body.append({
+                    "ph": "X", "name": key, "cat": "linkmap",
+                    "ts": t_cursor, "dur": dur, "pid": 0, "tid": tid,
+                    "args": {"step": rec.get("step"),
+                             "round": rd.get("round"),
+                             "rank": rec.get("rank", 0),
+                             "axis": rd.get("axis")},
+                })
+            t_cursor += dur
     for rec in records:
         kind = rec.get("kind")
         ts = rec.get("time")

@@ -148,16 +148,34 @@ def validate_pin(pin: Optional[str], mode: Optional[str], *,
 
 def planner_inputs(probe_dir: Optional[str] = None) -> Dict[str, Any]:
     """The alpha-beta constants the planner scores with, plus where they
-    came from: the newest dcn_probe ``alpha_beta_fit`` artifact when one
+    came from: the newest fit artifact (dcn_probe / calib_fit) when one
     exists, else documented fallback defaults (PLANNER_DEFAULT_ALPHA_MS
-    + the scaling model's DCN bandwidth)."""
+    + the scaling model's DCN bandwidth).
+
+    An artifact carrying a per-axis ``axes`` section prices each hop
+    from its OWN measured fit: the "dcn" entry overrides the blended
+    slow-link alpha/beta, and the "ici" entry's bandwidth replaces the
+    DEFAULT_ICI_GBPS guess — so a hierarchical plan's two hops are
+    scored from two measured links, with no caller change needed."""
     from gtopkssgd_tpu.obs import ledger
     fit = ledger.load_alpha_beta(search_dir=probe_dir)
     if fit is not None:
-        return {"alpha_ms": fit["alpha_ms"],
-                "beta_gbps": fit["beta_gbps"],
-                "ici_gbps": ledger.DEFAULT_ICI_GBPS,
-                "fit_source": fit["source"]}
+        out = {"alpha_ms": fit["alpha_ms"],
+               "beta_gbps": fit["beta_gbps"],
+               "ici_gbps": ledger.DEFAULT_ICI_GBPS,
+               "fit_source": fit["source"]}
+        axes = fit.get("axes")
+        if isinstance(axes, dict):
+            dcn = axes.get("dcn")
+            if dcn is not None:
+                out["alpha_ms"] = dcn["alpha_ms"]
+                out["beta_gbps"] = dcn["beta_gbps"]
+            ici = axes.get("ici")
+            if ici is not None:
+                out["ici_gbps"] = ici["beta_gbps"]
+            out["axes"] = {name: dict(ax)
+                           for name, ax in sorted(axes.items())}
+        return out
     return {"alpha_ms": PLANNER_DEFAULT_ALPHA_MS,
             "beta_gbps": ledger.DEFAULT_DCN_GBPS,
             "ici_gbps": ledger.DEFAULT_ICI_GBPS,

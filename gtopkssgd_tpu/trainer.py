@@ -351,6 +351,25 @@ class TrainConfig:
                                    # with goodput_frac below half its
                                    # EWMA before goodput_collapse fires
                                    # (obs.events.Thresholds)
+    obs_linkmap: bool = False      # per-(axis, peer) network weather
+                                   # map (obs/linkmap.py): carve each
+                                   # calibration capture's measured comm
+                                   # span over the schedule's
+                                   # round->peer join, keep EWMA
+                                   # latency/bandwidth per link, log a
+                                   # durable "linkmap" record per
+                                   # capture, feed the link_degraded
+                                   # rule. Rides the calibrator cadence,
+                                   # so it implies the same capture cost
+                                   # as obs_calib
+    obs_link_degraded_x: float = 4.0  # one link's EWMA latency over
+                                   # the fleet median by this factor
+                                   # counts as a degraded window
+                                   # (obs.events.Thresholds)
+    obs_link_degraded_windows: int = 3  # consecutive degraded windows
+                                   # before link_degraded fires; a
+                                   # recovered window re-arms
+                                   # (obs.events.Thresholds)
 
     # --- per-dataset defaults (the reference hardcoded these in DLTrainer) --
     def resolved(self) -> "TrainConfig":
@@ -480,7 +499,9 @@ class Trainer:
                     hbm_headroom_frac=cfg.obs_hbm_headroom_frac,
                     critpath_shift_windows=cfg.obs_critpath_shift_windows,
                     goodput_collapse_windows=(
-                        cfg.obs_goodput_collapse_windows)),
+                        cfg.obs_goodput_collapse_windows),
+                    link_degraded_x=cfg.obs_link_degraded_x,
+                    link_degraded_windows=cfg.obs_link_degraded_windows),
                 timeline=self.timeline,
             )
             if cfg.obs_events else None
@@ -700,6 +721,7 @@ class Trainer:
         # train(); its drift baseline is the EXACT inputs that priced
         # this run's plan. p == 1 has no wire to calibrate.
         self.calib = None
+        self.linkmap = None
         if cfg.obs_calib and cfg.obs_counters and self.p > 1:
             from gtopkssgd_tpu.obs.calib import CommCalibrator
             d = self._plan_decision
@@ -712,8 +734,23 @@ class Trainer:
             self.calib = CommCalibrator(
                 wire_mode, self.p,
                 baseline={key: inputs.get(key) for key in
-                          ("alpha_ms", "beta_gbps", "fit_source")},
-                metrics=self.metrics, monitor=self.monitor)
+                          ("alpha_ms", "beta_gbps", "ici_gbps",
+                           "fit_source")},
+                metrics=self.metrics, monitor=self.monitor,
+                ici_size=cfg.hier_ici)
+            # Link weather map (obs/linkmap.py): carves the SAME
+            # (wire_bytes, t_comm) capture the calibrator consumes over
+            # the schedule's round->peer join; rides the calib cadence,
+            # so it only exists when the calibrator does.
+            if cfg.obs_linkmap:
+                from gtopkssgd_tpu.obs.linkmap import LinkMap
+                self.linkmap = LinkMap(
+                    wire_mode, self.p, rank=self.process_rank,
+                    ici_size=cfg.hier_ici,
+                    alpha_ms=float(inputs.get("alpha_ms") or 0.1),
+                    beta_gbps=float(inputs.get("beta_gbps") or 25.0),
+                    ici_gbps=float(inputs.get("ici_gbps") or 1600.0),
+                    metrics=self.metrics, monitor=self.monitor)
         self._eval_step = self._build_eval_step()
         # Degrade fallback (recover-policy "degrade"): the sparse step
         # stays canonical; a dense-allreduce variant over the SAME
@@ -822,9 +859,18 @@ class Trainer:
         # biasing the serial alpha-beta fit (obs/calib.py).
         overlapped = (self._bucket_plan is not None
                       and self._bucket_plan.pipeline == "overlap")
+        t_comm_ms = float(t_comm_us) / 1e3 / spd
         self.calib.observe(step, wire_bytes=wire,
-                           t_comm_ms=float(t_comm_us) / 1e3 / spd,
+                           t_comm_ms=t_comm_ms,
                            overlapped=overlapped)
+        if self.linkmap is not None and not overlapped:
+            # Same sample, carved per link; overlapped spans are
+            # quarantined here for the same reason the calibrator
+            # quarantines them — a partially-hidden t_comm would bias
+            # every link's EWMA low. May raise AnomalyHalt (after its
+            # durable record), like any monitor-fed surface.
+            self.linkmap.observe(step, t_comm_ms=t_comm_ms,
+                                 wire_bytes=wire)
 
     def _log_critpath(self, step: int, spd: int, trace_dir: str,
                       cleanup: bool = True) -> None:

@@ -93,6 +93,12 @@ KINDS = frozenset({
                    # (obs/goodput.py): per-category seconds summing to
                    # measured wall (conservation), goodput_frac /
                    # other_frac, fsync'd every N steps + final summary
+    "linkmap",     # per-(axis, peer) link weather map (obs/linkmap.py):
+                   # one snapshot per calibrator capture with every
+                   # link's EWMA latency/bandwidth, the carved
+                   # per-round intervals, and the worst-link summary;
+                   # fsync'd — written BEFORE the link_degraded rule
+                   # can halt the run
 })
 
 _SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")

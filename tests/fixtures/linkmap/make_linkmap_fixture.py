@@ -1,0 +1,84 @@
+"""Regenerate the committed linkmap fixture shards in this directory.
+
+Four rank shards of one synthetic p=4 gtopk-tree run (shared
+config_hash), steps 1-4 at a 1.0 s cadence, each step carrying a
+durable "linkmap" record produced by the REAL obs/linkmap.py LinkMap
+(imported, not mirrored — the carve arithmetic is the thing under
+test) from hand-chosen spans:
+
+  clean ranks (2, 3)    observe exactly their modeled span, so their
+                        links' EWMAs equal the per-round model price
+                        t0 = alpha + (wire/2) * 8e-6 / beta
+                        (0.164 ms at the carve defaults)
+  degraded pair (0, 1)  both endpoints of a slow link measure the
+                        stall, so ranks 0 and 1 observe +DELAY_MS.
+                        The carve spreads each rank's inflation over
+                        its 2 tree rounds, so after the endpoint-mean
+                        merge dcn:0-1 sits at t0 + d/2 (1.164 ms),
+                        the adjacent pairs 0-2 and 1-3 at t0 + d/4
+                        (0.664 ms), and 2-3 at t0 — the worst link is
+                        EXACTLY the degraded pair, at 1.753x the
+                        fleet median (1.164 / 0.664).
+
+Spans repeat every step, so the EWMAs are constant and every number
+above is exact — test assertions in tests/test_linkmap.py pin them.
+
+Run from anywhere:  python tests/fixtures/linkmap/make_linkmap_fixture.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(HERE)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from gtopkssgd_tpu.obs.linkmap import (  # noqa: E402
+    LinkMap, rank_rounds, round_peers, round_weights)
+
+BASE_TIME = 1700000000.0
+STEP_S = 1.0          # wall-clock cadence of the synthetic run
+CONFIG_HASH = "linkfix0001beef"
+P, STEPS = 4, (1, 2, 3, 4)
+WIRE_BYTES = 400_000.0
+DELAY_MS = 2.0
+DEGRADED = (0, 1)     # the hand-degraded peer pair
+
+
+def manifest(rank: int) -> dict:
+    return {
+        "kind": "manifest", "time": BASE_TIME, "rank": rank,
+        "config_hash": CONFIG_HASH,
+        "dnn": "resnet20", "dataset": "cifar10",
+        "compression": "gtopk", "density": 0.01,
+        "nworkers": P, "batch_size": 4, "seed": 42,
+        "process_count": P, "process_index": rank,
+        "coordinator_address": "127.0.0.1:9999",
+    }
+
+
+def main() -> None:
+    for rank in range(P):
+        mine = rank_rounds(round_peers("gtopk", P), rank)
+        span = sum(round_weights(mine, WIRE_BYTES))
+        lm = LinkMap("gtopk", P, rank=rank)
+        path = os.path.join(HERE, f"metrics.rank{rank}.jsonl")
+        with open(path, "w") as fh:
+            fh.write(json.dumps(manifest(rank)) + "\n")
+            for step in STEPS:
+                t = span + (DELAY_MS if rank in DEGRADED else 0.0)
+                rec = lm.observe(step, t_comm_ms=t,
+                                 wire_bytes=WIRE_BYTES)
+                fh.write(json.dumps({
+                    "kind": "linkmap",
+                    "time": BASE_TIME + step * STEP_S,
+                    "rank": rank, **rec}) + "\n")
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
