@@ -183,6 +183,59 @@ def plan_rows(sizes: dict, densities) -> list:
     return rows
 
 
+FORECAST_WORKERS = (256, 1024)
+# (tree label, ici_size): flat dp prices every hop on the slow DCN
+# link; the pod tree keeps 16-chip ICI domains local and pays DCN only
+# across slices (scaling_model's slice split) — the two axis trees
+# ROADMAP item 3 asks the evidence rows to span.
+FORECAST_TREES = (("flat", 1), ("pod", 16))
+
+
+def forecast_rows(sizes: dict, densities) -> list:
+    """Scale-out forecast evidence rows (ROADMAP item 3): modeled comm
+    ms at P in {256, 1024} across two axis trees x two wire schedules,
+    priced from the planner's own inputs (obs/forecast.py grid over the
+    committed fit artifact), with uncertainty columns from the fit's
+    Theil-Sen residual when the artifact records one (probe-era
+    artifacts don't — their bands are honestly absent/0). One row per
+    (size, density, P, schedule, tree); the per-P recommended plan and
+    the tree->balanced crossover ride each (size, density) group."""
+    from gtopkssgd_tpu.obs import forecast as _forecast
+    from gtopkssgd_tpu.parallel.planner import planner_inputs
+
+    inp = planner_inputs()
+    fit = {"alpha_ms": inp["alpha_ms"], "beta_gbps": inp["beta_gbps"],
+           "ici_gbps": inp["ici_gbps"], "resid_ms": inp.get("resid_ms"),
+           "fit_source": inp.get("fit_source")}
+    rows = []
+    for label, n in sizes.items():
+        for rho in densities:
+            k = k_for_density(n, rho)
+            params = {"mode": "gtopk", "n": n, "k": k, "codec": "fp32"}
+            grid = _forecast.grid_rows(
+                params, fit, compute_ms=0.0,
+                targets=FORECAST_WORKERS, trees=FORECAST_TREES)
+            recs = _forecast.recommend(grid)
+            cross = _forecast.crossover_p(
+                params, fit, p_max=max(FORECAST_WORKERS),
+                trees=FORECAST_TREES)
+            for r in grid:
+                rows.append({
+                    "size": label, "n": n, "density": rho, "k": k,
+                    "p": r["p"], "plan": r["plan"],
+                    "wire_mode": r["wire_mode"],
+                    "ici_size": r["ici_size"], "msgs": r["msgs"],
+                    "comm_ms_model": r["comm_ms"],
+                    "comm_ms_lo": r["step_ms_lo"],
+                    "comm_ms_hi": r["step_ms_hi"],
+                    "band_ms": r["band_ms"],
+                    "recommended": r["plan"] == recs[r["p"]]["plan"],
+                    "crossover_p": cross,
+                    "fit_source": fit.get("fit_source"),
+                })
+    return rows
+
+
 BUCKET_ALPHAS_MS = (0.1, 5.0, 22.0)   # ICI-class, mid, measured-DCN latency
 BUCKET_MODELS = ("resnet50", "vgg16")
 BUCKET_DENSITY = 0.001
@@ -338,6 +391,10 @@ def main():
         # Pipeline evidence rows: serial-vs-overlapped modeled span per
         # (model, alpha, P, B) — model-side, full grid always.
         "pipeline_rows": pipeline_rows(),
+        # Scale-out forecast evidence rows: modeled P in {256, 1024}
+        # across axis trees with uncertainty columns (ROADMAP item 3) —
+        # model-side, full grid always.
+        "forecast_rows": forecast_rows(SIZES, DENSITIES),
     }
     out = args.out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

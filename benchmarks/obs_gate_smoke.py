@@ -63,6 +63,7 @@ SMOKES = {
     "critpath": ("critpath",),
     "goodput": ("goodput",),
     "linkmap": ("linkmap",),
+    "forecast": ("forecast",),
     "lint": ("lint",),
 }
 # Sub-smokes a selected one cannot run without: the plan A/B reuses the
@@ -1019,6 +1020,139 @@ def run_linkmap_smoke(out_dir: str) -> dict:
     }
 
 
+def run_forecast_smoke(out_dir: str) -> dict:
+    """Scale-out forecast smoke (the forecast tentpole's consumer):
+    a clean and a drifted leg of a SYNTHETIC p=4 gtopk run — no
+    trainer, no timing noise, so the baseline can pin the hindcast
+    arithmetic, the per-target recommendation strings, and the
+    forecast_drift halt contract exactly. Both legs write real
+    metrics shards (the layout ``report forecast`` reads) through a
+    live StepForecaster. Returns the fields the main run logs as ONE
+    "forecast" record:
+
+      clean leg (1 capture)      the critpath wall is CONSTRUCTED as
+                                 compute + select + modeled comm x
+                                 degrade (same predict_comm_ms the
+                                 forecaster prices with), so the
+                                 hindcast error is exactly 1.0 — the
+                                 model-explains-its-own-run ceiling
+                                 pin (clean_err_x, atol 1e-6). The
+                                 durable record re-read from the shard
+                                 parameterizes ``report forecast``
+                                 (clean_rc 0) and carries the per-P
+                                 grid (clean_n_rows) plus the exact
+                                 recommendation indicators the regress
+                                 plane pins as strings
+      drift leg (3 captures)     the wall is 10x the model's
+                                 prediction, so each capture's
+                                 hindcast error (~10x) exceeds
+                                 forecast_drift_x=4.0; the streak
+                                 fires forecast_drift on capture 3
+                                 with halt_on=warn — with the
+                                 forecast AND event records already
+                                 durable in the shard (drift_fired,
+                                 durable_before_halt, drift_halted
+                                 all exactly 1, drift_windows exactly
+                                 3)
+
+    Everything here is deterministic arithmetic (synthetic budgets,
+    the fitted-model identity, an EWMA of a constant stream), so the
+    baseline pins the ratio fields tight and the indicators exact."""
+    from gtopkssgd_tpu.obs import forecast as _forecast
+    from gtopkssgd_tpu.obs import report
+    from gtopkssgd_tpu.obs.events import (AnomalyHalt, AnomalyMonitor,
+                                          HALT_EXIT_CODE, Thresholds)
+    from gtopkssgd_tpu.obs.ledger import predict_comm_ms, wire_mode_for
+    from gtopkssgd_tpu.utils.metrics import MetricsLogger
+
+    params = {"mode": "gtopk", "p": 4, "n": 1_000_000, "k": 10_000,
+              "codec": "fp32", "schedule": "tree",
+              "bucketing": "concat", "buckets": None, "ici_size": 1}
+    fit = {"alpha_ms": 0.5, "beta_gbps": 8.0, "resid_ms": 0.02,
+           "fit_source": "smoke"}
+    compute_ms, select_ms = 10.0, 2.0
+    # One degraded link among four: degrade_factor = mean/median = 1.25.
+    links = [{"ewma_ms": 1.0}, {"ewma_ms": 1.0},
+             {"ewma_ms": 1.0}, {"ewma_ms": 2.0}]
+    degrade = _forecast.degrade_factor(links)
+    wm = wire_mode_for(params["mode"], params["schedule"],
+                       params["bucketing"])
+    comm = predict_comm_ms(wm, params["p"], n=params["n"],
+                           k=params["k"], alpha_ms=fit["alpha_ms"],
+                           beta_gbps=fit["beta_gbps"],
+                           codec=params["codec"])
+    pred_ms = compute_ms + select_ms + comm * degrade
+
+    def _critpath(wall_ms: float) -> dict:
+        return {"wall_us": wall_ms * 1e3,
+                "t_compute_us": compute_ms * 1e3,
+                "t_select_us": select_ms * 1e3}
+
+    # ---- clean leg: measured == modeled, so the hindcast is exact.
+    clean_dir = os.path.join(out_dir, "forecast_clean")
+    log = MetricsLogger(out_dir=clean_dir, rank=0, shard=True)
+    fc = _forecast.StepForecaster(params, baseline=fit, metrics=log)
+    fc.note_calib({"alpha_fit_ms": fit["alpha_ms"],
+                   "beta_fit_gbps": fit["beta_gbps"],
+                   "resid_ms": fit["resid_ms"]})
+    fc.note_linkmap({"links": links})
+    fc.note_critpath(_critpath(pred_ms))
+    rec = fc.observe(step=1)
+    log.close()
+    clean_recs, _ = report.load_records(clean_dir)
+    clean_durable = any(r.get("kind") == "forecast" for r in clean_recs)
+    clean_rc = report.run_forecast([clean_dir])
+
+    # ---- drift leg: reality 10x the model -> streak -> fire -> halt.
+    drift_dir = os.path.join(out_dir, "forecast_drift")
+    log = MetricsLogger(out_dir=drift_dir, rank=0, shard=True)
+    mon = AnomalyMonitor(
+        thresholds=Thresholds(forecast_drift_x=4.0,
+                              forecast_drift_windows=3),
+        metrics=log, halt_on="warn")
+    fcd = _forecast.StepForecaster(params, baseline=fit, metrics=log,
+                                   monitor=mon)
+    fcd.note_linkmap({"links": links})
+    halted = 0.0
+    try:
+        for step in range(1, 4):
+            fcd.note_critpath(_critpath(10.0 * pred_ms))
+            fcd.observe(step)
+    except AnomalyHalt:
+        halted = float(HALT_EXIT_CODE == 44)
+    log.close()
+    ev = next((e for e in mon.events if e["rule"] == "forecast_drift"),
+              None)
+    drift_recs, _ = report.load_records(drift_dir)
+    n_forecast = sum(1 for r in drift_recs
+                     if r.get("kind") == "forecast")
+    durable = any(r.get("kind") == "event"
+                  and r.get("rule") == "forecast_drift"
+                  for r in drift_recs)
+    return {
+        "clean_err_x": float(rec["hindcast_err_x"]),
+        "clean_rc": float(clean_rc),
+        "clean_durable": float(clean_durable),
+        "clean_n_rows": float(len(rec["rows"])),
+        "clean_degrade_x": round(float(rec["degrade_x"]), 6),
+        "clean_rec_p256_balanced": float(
+            str(rec.get("rec_p256", "")).startswith("balanced")),
+        "clean_rec_p1024_balanced": float(
+            str(rec.get("rec_p1024", "")).startswith("balanced")),
+        "clean_has_crossover": float(rec.get("crossover_p")
+                                     is not None),
+        "clean_band_p256_ms": round(
+            float(rec["step_ms_hi_p256"] - rec["step_ms_p256"]), 6),
+        "drift_fired": float(ev is not None),
+        "drift_halted": halted,
+        "drift_windows": float(ev["windows"]) if ev else -1.0,
+        "drift_err_x": (round(float(ev["value"]), 6)
+                        if ev else -1.0),
+        "durable_before_halt": float(durable),
+        "drift_n_forecast_records": float(n_forecast),
+    }
+
+
 def run_smoke(out_dir: str, only=None) -> str:
     """Train the canonical run; returns the run dir (metrics.jsonl inside).
 
@@ -1073,6 +1207,8 @@ def run_smoke(out_dir: str, only=None) -> str:
                    if _selected("goodput", only) else None)
     linkmap_rec = (run_linkmap_smoke(out_dir)
                    if _selected("linkmap", only) else None)
+    forecast_rec = (run_forecast_smoke(out_dir)
+                    if _selected("forecast", only) else None)
     critpath_rec = critpath_real = None
     if _selected("critpath", only):
         critpath_rec, critpath_real = run_critpath_smoke(out_dir)
@@ -1173,6 +1309,14 @@ def run_smoke(out_dir: str, only=None) -> str:
         # durable before the raise. Durable evidence -> flush=True.
         if linkmap_rec is not None:
             t.metrics.log("linkmap", flush=True, **linkmap_rec)
+        # And the forecast smoke: the clean leg's exact hindcast
+        # ceiling (measured == modeled -> err 1.0), the per-target
+        # recommendation indicators and resid-derived band, and the
+        # drifted leg's forecast_drift fire/halt contract with the
+        # forecast + event records durable before the raise.
+        # Durable evidence -> flush=True.
+        if forecast_rec is not None:
+            t.metrics.log("forecast", flush=True, **forecast_rec)
         # And the critical-path smoke: one REAL per-step stage-interval
         # record from the overlap arm (so the registry's wait_frac /
         # crit_stage_modal path runs on gate data) plus the summary the

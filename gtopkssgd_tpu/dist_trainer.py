@@ -273,6 +273,27 @@ reference's only telemetry was text logs):
                                          anomaly fires (default 3; a
                                          recovered window re-arms;
                                          honors --obs-halt-on)
+    --obs-forecast / --no-obs-forecast   scale-out forecast plane
+                                         (obs.forecast): hindcast the
+                                         analytic step model against
+                                         this run each calibration
+                                         capture, forecast step time /
+                                         goodput at the P targets
+                                         across schedules and axis
+                                         trees, one durable 'forecast'
+                                         record per capture. Needs
+                                         --obs-calib (rides its
+                                         cadence); default off.
+                                         Inspect with 'report forecast'
+    --obs-forecast-targets LIST          comma-separated modeled worker
+                                         counts the forecast grid
+                                         prices (default 32,256,1024)
+    --obs-forecast-drift-x X             hindcast error factor beyond
+                                         which a capture counts as
+                                         drifted; 3 consecutive drifted
+                                         captures fire forecast_drift
+                                         (default 4.0; honors
+                                         --obs-halt-on)
     --registry DIR                       append one summary line per run
                                          to DIR/runs.jsonl (obs.registry:
                                          manifest header + steps/sec,
@@ -599,6 +620,25 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="consecutive degraded windows before "
                         "link_degraded fires (a recovered window "
                         "re-arms; honors --obs-halt-on)")
+    p.add_argument("--obs-forecast",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="scale-out forecast plane (obs.forecast): "
+                        "hindcast the analytic step model against this "
+                        "run each calibration capture, then forecast "
+                        "step time/goodput at the P targets across "
+                        "schedules and axis trees — one durable "
+                        "'forecast' record per capture, feeding the "
+                        "forecast_drift rule. Needs --obs-calib (rides "
+                        "its cadence); inspect with 'report forecast'")
+    p.add_argument("--obs-forecast-targets", default="32,256,1024",
+                   metavar="LIST",
+                   help="comma-separated modeled worker counts the "
+                        "forecast grid prices")
+    p.add_argument("--obs-forecast-drift-x", type=float, default=4.0,
+                   help="hindcast error factor beyond which a capture "
+                        "counts as drifted; 3 consecutive drifted "
+                        "captures fire forecast_drift (honors "
+                        "--obs-halt-on)")
     p.add_argument("--registry", default=None, metavar="DIR",
                    help="append this run's summary line (manifest subset "
                         "+ steps/sec, comm ratio, fitted alpha/beta, "
@@ -713,6 +753,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         obs_linkmap=args.obs_linkmap,
         obs_link_degraded_x=args.obs_link_degraded_x,
         obs_link_degraded_windows=args.obs_link_degraded_windows,
+        obs_forecast=args.obs_forecast,
+        obs_forecast_targets=args.obs_forecast_targets,
+        obs_forecast_drift_x=args.obs_forecast_drift_x,
         registry=args.registry,
         comm_model_fit=args.comm_model_fit,
         inject=args.inject,

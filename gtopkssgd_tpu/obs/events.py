@@ -94,6 +94,19 @@ synced (no extra device reads):
                                   Fed by LinkMap.observe through
                                   ``observe_links`` AFTER the durable
                                   linkmap record is written
+  forecast_drift        warn      the forecast plane's hindcast error
+                                  (obs/forecast.py: predicted vs
+                                  measured step time on THIS run)
+                                  stayed beyond ``forecast_drift_x`` for
+                                  ``forecast_drift_windows`` CONSECUTIVE
+                                  observations — the digital twin no
+                                  longer explains the run it was fitted
+                                  on, so its P-target recommendations
+                                  are not evidence. The streak IS the
+                                  warmup; fires once per streak, then
+                                  re-arms. Fed by StepForecaster.observe
+                                  through ``observe_forecast`` AFTER the
+                                  durable forecast record is written
 
 Every rule name is registered in the module-level ``RULES`` frozenset
 (the event-plane mirror of ``utils/metrics.KINDS``): ``_emit`` rejects
@@ -143,6 +156,8 @@ RULES = frozenset({
     "goodput_collapse",      # goodput_frac fell off its own EWMA
     "link_degraded",         # one (axis, peer) link's EWMA pulled away
                              # from the fleet median (obs/linkmap.py)
+    "forecast_drift",        # hindcast error beyond bound — the model
+                             # stopped explaining the run (obs/forecast)
 })
 
 
@@ -203,6 +218,14 @@ class Thresholds:
                                      # before link_degraded fires (the
                                      # streak is the rule's warmup —
                                      # one noisy window never fires)
+    forecast_drift_x: float = 4.0    # hindcast error factor (predicted
+                                     # vs measured step time, either
+                                     # direction) above which a window
+                                     # counts as drifted
+    forecast_drift_windows: int = 3  # consecutive drifted windows
+                                     # before forecast_drift fires (the
+                                     # streak is the warmup — one noisy
+                                     # capture never fires)
 
     def age_max(self, rho: Optional[float]) -> float:
         if self.residual_age_max > 0:
@@ -297,6 +320,9 @@ class AnomalyMonitor:
         # degraded-window streaks. A link leaving the offender set
         # drops its streak entirely (re-arm on recovery).
         self._link_streaks: Dict[str, int] = {}
+        # Forecast-plane state (observe_forecast): the current
+        # consecutive hindcast-drifted streak. Recovery resets it.
+        self._fc_streak = 0
 
     # ---------------------------------------------------------- the rules
     def _check(self, step: int, loss: Optional[float],
@@ -649,6 +675,41 @@ class AnomalyMonitor:
             out.append(ev)
         return out
 
+    # ------------------------------------------- forecast plane (forecast)
+    def _check_forecast(self, step: int, err_x: Optional[float]
+                        ) -> List[Dict[str, Any]]:
+        th = self.th
+        out: List[Dict[str, Any]] = []
+        if not _finite(err_x):
+            return out
+        err = float(err_x)
+        # Streak-is-the-warmup, like link_degraded: a capture whose
+        # hindcast error exceeds the bound extends the streak, a
+        # recovered capture resets it, and nothing fires before
+        # forecast_drift_windows consecutive drifted captures.
+        if err > th.forecast_drift_x:
+            self._fc_streak += 1
+        else:
+            self._fc_streak = 0
+        if self._fc_streak >= th.forecast_drift_windows:
+            n = self._fc_streak
+            # Fire once per streak, then re-arm: a model that STAYS
+            # wrong fires again only after another full streak.
+            self._fc_streak = 0
+            out.append({
+                "rule": "forecast_drift", "severity": "warn",
+                "step": step, "value": round(err, 6),
+                "threshold": round(th.forecast_drift_x, 6),
+                "windows": n,
+                "message": (f"hindcast error {err:.3g}x stayed beyond "
+                            f"{th.forecast_drift_x:g}x for {n} "
+                            "consecutive captures — the forecast model "
+                            "no longer explains the run it was fitted "
+                            "on; its scale-out recommendations are not "
+                            "evidence"),
+            })
+        return out
+
     # ------------------------------------------------------------- public
     def _emit(self, fired: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         """Record, persist (fsync'd), mark on the timeline, and — after
@@ -765,6 +826,16 @@ class AnomalyMonitor:
         so the evidence naming the degraded hop survives the exit-44
         halt."""
         return self._emit(self._check_links(step, dict(ewma_ms_by_link)))
+
+    def observe_forecast(self, step: int, *,
+                         err_x: Optional[float] = None
+                         ) -> List[Dict[str, Any]]:
+        """Evaluate the forecast_drift rule against one forecast
+        capture's hindcast error factor (obs/forecast.py). Same
+        emit/halt contract as observe — StepForecaster writes its
+        durable forecast record BEFORE calling this, so the prediction
+        that failed survives the exit-44 halt."""
+        return self._emit(self._check_forecast(step, err_x))
 
     def summary(self) -> Dict[str, int]:
         """{rule: count} over the monitor's lifetime (test/report aid)."""

@@ -500,6 +500,14 @@ def merge(targets: Sequence[str],
     crit_rows, crit_budget = critpath_rows(records_by_rank,
                                            monitor=monitor)
     gp_rows, gp_by_rank, gp_fleet = goodput_rows(records_by_rank)
+    # Forecast plane: the last forecast record any rank shipped (rank 0
+    # in practice — the StepForecaster is fed from each rank's own
+    # budgets, and the per-P grid is rank-agnostic). None pre-forecast.
+    forecast = None
+    for rank in sorted(records_by_rank):
+        for rec in records_by_rank[rank]:
+            if rec.get("kind") == "forecast":
+                forecast = rec
     return {
         "shards": {r: shards[r] for r in sorted(shards)},
         "ranks": sorted(shards),
@@ -512,6 +520,7 @@ def merge(targets: Sequence[str],
         "goodput": gp_rows,
         "goodput_by_rank": gp_by_rank,
         "goodput_fleet": gp_fleet,
+        "forecast": forecast,
         "events": list(monitor.events),
     }
 

@@ -210,6 +210,12 @@ def _parse_fit_artifact(path: str) -> Optional[Dict[str, Any]]:
     out: Dict[str, Any] = {"alpha_ms": float(alpha),
                            "beta_gbps": float(beta),
                            "source": os.path.basename(path)}
+    # Theil-Sen residual noise floor (obs/calib.py) — the forecast
+    # plane's uncertainty-band source. Probe-era artifacts predate it;
+    # absent means "no measured band", never 0-invented.
+    if isinstance(fit.get("resid_ms"), (int, float)) \
+            and fit["resid_ms"] >= 0:
+        out["resid_ms"] = float(fit["resid_ms"])
     axes = doc.get("axes")
     if isinstance(axes, dict):
         clean: Dict[str, Dict[str, float]] = {}
@@ -220,6 +226,9 @@ def _parse_fit_artifact(path: str) -> Optional[Dict[str, Any]]:
                     and ax["beta_gbps"] > 0):
                 clean[str(name)] = {"alpha_ms": float(ax["alpha_ms"]),
                                     "beta_gbps": float(ax["beta_gbps"])}
+                if isinstance(ax.get("resid_ms"), (int, float)) \
+                        and ax["resid_ms"] >= 0:
+                    clean[str(name)]["resid_ms"] = float(ax["resid_ms"])
         if clean:
             out["axes"] = clean
     return out

@@ -80,6 +80,13 @@ REGRESS_CHECKS: Tuple[Tuple[str, float, float], ...] = (
     # whose productive share quietly dropped ten points under the same
     # config is the regression this line pins.
     ("goodput_frac", 0.0, 0.10),
+    # hindcast_err_x (forecast plane, obs/forecast.py: predicted vs
+    # measured step time on the run itself) lives near 1.0 by
+    # construction; a purely absolute 0.5 slack pins it — a model whose
+    # self-explanation quietly worsened past half a turn under the same
+    # config is a forecast regression, the offline mirror of the live
+    # forecast_drift rule.
+    ("hindcast_err_x", 0.0, 0.50),
 )
 
 # String-valued stats checked for EXACT equality (the numeric loop's
@@ -87,7 +94,10 @@ REGRESS_CHECKS: Tuple[Tuple[str, float, float], ...] = (
 # flips serial<->overlap under the same config is a plan regression,
 # not noise; the modal critical stage moving compute<->wait under the
 # same config means the run's bottleneck moved, which is exactly what
-# the critpath plane exists to flag).
+# the critpath plane exists to flag). The forecast plane's per-target
+# recommendations (forecast_rec_p256 etc.) join this set dynamically in
+# regress(): a silent flip of the recommended P=256 plan under the same
+# config must fail the gate.
 REGRESS_EXACT_STR: Tuple[str, ...] = ("pipeline", "crit_stage_modal")
 
 
@@ -129,6 +139,7 @@ def run_summary(records: Sequence[Dict[str, Any]]
     saw_memwatch = False
     recompile_count = 0
     last_goodput = None
+    last_forecast = None
     for rec in records:
         kind = rec.get("kind")
         if kind == "manifest" and manifest is None:
@@ -180,6 +191,11 @@ def run_summary(records: Sequence[Dict[str, Any]]
             # cumulative ledger records (obs/goodput.py): the LAST one
             # is the run's accounting, so it alone feeds the entry.
             last_goodput = rec
+        elif kind == "forecast":
+            # scale-out forecast records (obs/forecast.py): the LAST
+            # one carries the settled hindcast error and per-P
+            # recommendations, so it alone feeds the entry.
+            last_forecast = rec
         elif kind == "recovery" and rec.get("final_status") is not None:
             final_status = rec.get("final_status")
     if manifest is None:
@@ -235,6 +251,22 @@ def run_summary(records: Sequence[Dict[str, Any]]
         if _finite(last_goodput.get("other_frac")):
             stats["other_frac"] = round(
                 float(last_goodput["other_frac"]), 6)
+    if last_forecast is not None:
+        # Forecast plane: the hindcast error (numeric drift check) plus
+        # the recommended plan string at each P target
+        # (forecast_rec_p{P}, exact-string checked in regress() — a
+        # calibrated artifact flipping the P=256 recommendation is a
+        # DELIBERATE change that must fail a same-config gate).
+        if _finite(last_forecast.get("hindcast_err_x")):
+            stats["hindcast_err_x"] = round(
+                float(last_forecast["hindcast_err_x"]), 6)
+        if _finite(last_forecast.get("crossover_p")):
+            stats["forecast_crossover_p"] = int(
+                last_forecast["crossover_p"])
+        for field in sorted(last_forecast):
+            if (field.startswith("rec_p") and field[5:].isdigit()
+                    and isinstance(last_forecast[field], str)):
+                stats["forecast_" + field] = last_forecast[field]
     if crit_counts:
         # Modal stage; ties break by critpath.STAGES order (inlined as
         # a sort over the fixed tuple to keep the registry stdlib-only).
@@ -337,6 +369,8 @@ def history_rows(entries: Sequence[Dict[str, Any]],
             str(stats.get("crit_stage_modal", "-")),
             _cell(stats.get("wait_frac")),
             _cell(stats.get("goodput_frac")),
+            _cell(stats.get("hindcast_err_x")),
+            str(stats.get("forecast_rec_p256", "-")),
             str(stats.get("final_status", "-")),
         ])
     return rows
@@ -346,7 +380,8 @@ HISTORY_HEADER = ["config", "git", "steps", "steps/s", "loss",
                   "comm_ratio", "alpha_ms", "beta_gbps", "axes",
                   "recall", "wireB/step", "peak_hbm", "recomp",
                   "pipeline", "B", "ovl_frac", "crit_stage",
-                  "wait_frac", "goodput", "status"]
+                  "wait_frac", "goodput", "hindcast", "fc_p256",
+                  "status"]
 
 
 def pick_baseline(entry: Dict[str, Any],
@@ -408,7 +443,15 @@ def regress(entry: Dict[str, Any], baseline: Dict[str, Any]
                 failures += 1
         rows.append([field, _cell(base.get(field)), _cell(cur.get(field)),
                      tol_s, status])
-    for field in REGRESS_EXACT_STR:
+    # Forecast recommendations are dynamic like the per-axis fits (one
+    # per configured P target), so every forecast_rec_p* present on
+    # either side joins the exact-string set: the recommended plan
+    # flipping under the same config — a calibrated artifact repricing
+    # the grid — must fail the gate, never slide through silently.
+    forecast_checks = tuple(
+        field for field in sorted(set(cur) | set(base))
+        if field.startswith("forecast_rec_p"))
+    for field in REGRESS_EXACT_STR + forecast_checks:
         b, c = base.get(field), cur.get(field)
         if b is None and c is None:
             continue

@@ -28,6 +28,11 @@ or gate one against a committed baseline.
     python -m gtopkssgd_tpu.obs.report linkmap <run>... # per-(axis, peer)
                                                         # network weather map +
                                                         # per-axis calib fits
+    python -m gtopkssgd_tpu.obs.report forecast <run>...
+                                                        # hindcast error + per-P
+                                                        # scale-out forecast
+                                                        # grid with uncertainty
+                                                        # bands, crossover P
     python -m gtopkssgd_tpu.obs.report history <dir>    # registry trend table
                                                         # (obs/registry.py)
     python -m gtopkssgd_tpu.obs.report regress <run> --registry <dir>
@@ -1168,6 +1173,54 @@ def run_linkmap(targets: Sequence[str],
     return 0 if summary["rows"] else 1
 
 
+def run_forecast(targets: Sequence[str],
+                 json_out: Optional[str] = None,
+                 search_dir: Optional[str] = None,
+                 forecast_targets: Optional[str] = None) -> int:
+    """``forecast`` subcommand: the scale-out forecast view
+    (obs/forecast.py) — hindcast error against the run's own measured
+    step time, the per-P recommendation grid with resid-derived
+    uncertainty columns, and the tree->balanced crossover P. A run that
+    logged live ``forecast`` records is reported from its last one;
+    otherwise the view is rebuilt offline from the stream's manifest +
+    critpath + calib + linkmap records (and the fit-artifact lookup
+    under ``--probe-dir``)."""
+    from gtopkssgd_tpu.obs import forecast as _forecast
+
+    records = []
+    for target in targets:
+        try:
+            recs, bad = load_records(target)
+        except OSError as e:
+            print(f"cannot read {target}: {e}")
+            return 2
+        if bad:
+            print(f"note: {target}: skipped {bad} malformed line(s)")
+        records.extend(recs)
+    ts = None
+    if forecast_targets:
+        try:
+            ts = tuple(int(t) for t in forecast_targets.split(",")
+                       if t.strip())
+        except ValueError:
+            print(f"--targets must be comma-separated worker counts, "
+                  f"got {forecast_targets!r}")
+            return 2
+    summary = _forecast.summarize_forecast(records, search_dir=search_dir,
+                                           targets=ts)
+    print(_forecast.format_forecast(summary))
+    if json_out:
+        payload = {k: v for k, v in summary.items()}
+        if isinstance(payload.get("recs"), dict):
+            payload["recs"] = {str(p): row for p, row
+                               in payload["recs"].items()}
+        with open(json_out, "w") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {json_out}")
+    return 0 if summary.get("rows") else 1
+
+
 def _fit_provenance_line(records: Iterable[dict]) -> Optional[str]:
     """The manifest's stamped comm-model provenance ("which comm model
     priced this plan"), or None for runs that predate the stamp. Printed
@@ -1880,6 +1933,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.add_argument("--json", dest="json_out", default=None)
         a = ap.parse_args(argv[1:])
         return run_linkmap(a.targets, json_out=a.json_out)
+    if argv and argv[0] == "forecast":
+        ap = argparse.ArgumentParser(
+            "gtopkssgd_tpu.obs.report forecast",
+            description="Scale-out forecast view (obs/forecast.py): "
+                        "hindcast error vs the run's own measured step "
+                        "time, the per-P recommendation grid with "
+                        "uncertainty bands, and the tree->balanced "
+                        "crossover P.")
+        ap.add_argument("targets", nargs="+",
+                        help="run dirs or record files (fleet dirs ok)")
+        ap.add_argument("--targets-p", dest="forecast_targets",
+                        default=None, metavar="LIST",
+                        help="comma-separated modeled worker counts "
+                             "(default 32,256,1024, or the run's own "
+                             "forecast records)")
+        ap.add_argument("--probe-dir", default=None,
+                        help="where to look for fit artifacts when the "
+                             "stream has no calib records (default "
+                             "benchmarks/results/)")
+        ap.add_argument("--json", dest="json_out", default=None)
+        a = ap.parse_args(argv[1:])
+        return run_forecast(a.targets, json_out=a.json_out,
+                            search_dir=a.probe_dir,
+                            forecast_targets=a.forecast_targets)
     if argv and argv[0] == "history":
         ap = argparse.ArgumentParser(
             "gtopkssgd_tpu.obs.report history",

@@ -224,6 +224,12 @@ def timeline_from_records(records: List[dict],
     # (axis, peer-pair) link, anchored like the critpath lanes so the
     # observing rank's comm window ends at the record's wall time — the
     # Perfetto view of WHICH hop each round's time went to.
+    # Link lanes allocate above the per-rank stage lanes, which occupy
+    # [100, 100 + max rank]: a fixed 200 base aliased lanes on fleets
+    # with >= 100 ranks (rank 100's stage lane IS tid 200).
+    _max_rank = max((r for per_rank in crit_by_step.values()
+                     for r in per_rank), default=-1)
+    link_base = max(200, 101 + _max_rank)
     link_tids: Dict[str, int] = {}
     for rec in records:
         if (rec.get("kind") != "linkmap"
@@ -246,7 +252,7 @@ def timeline_from_records(records: List[dict],
             key = f"{rd.get('axis', '?')}:{lo}-{hi}"
             tid = link_tids.get(key)
             if tid is None:
-                tid = link_tids[key] = 200 + len(link_tids)
+                tid = link_tids[key] = link_base + len(link_tids)
                 events.append({
                     "ph": "M", "name": "thread_name", "pid": 0,
                     "tid": tid, "args": {"name": f"link {key}"}})

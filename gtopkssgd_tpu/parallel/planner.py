@@ -164,12 +164,20 @@ def planner_inputs(probe_dir: Optional[str] = None) -> Dict[str, Any]:
                "beta_gbps": fit["beta_gbps"],
                "ici_gbps": ledger.DEFAULT_ICI_GBPS,
                "fit_source": fit["source"]}
+        # Theil-Sen residual noise floor, when the artifact records one
+        # (calib_fit does; probe-era artifacts don't). The forecast
+        # plane derives its uncertainty bands from this — absent means
+        # absent, not zero-by-decree.
+        if "resid_ms" in fit:
+            out["resid_ms"] = fit["resid_ms"]
         axes = fit.get("axes")
         if isinstance(axes, dict):
             dcn = axes.get("dcn")
             if dcn is not None:
                 out["alpha_ms"] = dcn["alpha_ms"]
                 out["beta_gbps"] = dcn["beta_gbps"]
+                if "resid_ms" in dcn:
+                    out["resid_ms"] = dcn["resid_ms"]
             ici = axes.get("ici")
             if ici is not None:
                 out["ici_gbps"] = ici["beta_gbps"]

@@ -370,6 +370,25 @@ class TrainConfig:
                                    # before link_degraded fires; a
                                    # recovered window re-arms
                                    # (obs.events.Thresholds)
+    obs_forecast: bool = False     # scale-out forecast plane
+                                   # (obs/forecast.py): hindcast the
+                                   # analytic step model against THIS
+                                   # run each calibration capture, then
+                                   # forecast step time / goodput at
+                                   # the P targets across schedules and
+                                   # axis trees. One durable "forecast"
+                                   # record per capture; feeds the
+                                   # forecast_drift rule. Requires
+                                   # obs_calib (rides its cadence)
+    obs_forecast_targets: str = "32,256,1024"  # comma-separated modeled
+                                   # worker counts the forecast grid
+                                   # prices (ROADMAP item 3 evidence
+                                   # targets)
+    obs_forecast_drift_x: float = 4.0  # hindcast error factor beyond
+                                   # which a capture counts as drifted;
+                                   # 3 consecutive drifted captures
+                                   # fire forecast_drift
+                                   # (obs.events.Thresholds)
 
     # --- per-dataset defaults (the reference hardcoded these in DLTrainer) --
     def resolved(self) -> "TrainConfig":
@@ -501,7 +520,8 @@ class Trainer:
                     goodput_collapse_windows=(
                         cfg.obs_goodput_collapse_windows),
                     link_degraded_x=cfg.obs_link_degraded_x,
-                    link_degraded_windows=cfg.obs_link_degraded_windows),
+                    link_degraded_windows=cfg.obs_link_degraded_windows,
+                    forecast_drift_x=cfg.obs_forecast_drift_x),
                 timeline=self.timeline,
             )
             if cfg.obs_events else None
@@ -722,6 +742,7 @@ class Trainer:
         # this run's plan. p == 1 has no wire to calibrate.
         self.calib = None
         self.linkmap = None
+        self.forecaster = None
         if cfg.obs_calib and cfg.obs_counters and self.p > 1:
             from gtopkssgd_tpu.obs.calib import CommCalibrator
             d = self._plan_decision
@@ -750,6 +771,41 @@ class Trainer:
                     alpha_ms=float(inputs.get("alpha_ms") or 0.1),
                     beta_gbps=float(inputs.get("beta_gbps") or 25.0),
                     ici_gbps=float(inputs.get("ici_gbps") or 1600.0),
+                    metrics=self.metrics, monitor=self.monitor)
+            # Scale-out forecast plane (obs/forecast.py): the digital
+            # twin hindcasts against this run and forecasts the P
+            # targets, riding the same capture cadence (it consumes the
+            # calibrator's refits, the weather map's snapshots, and the
+            # critpath budgets the loop already produces).
+            if cfg.obs_forecast:
+                from gtopkssgd_tpu.obs.forecast import StepForecaster
+                bplan = self._bucket_plan
+                fc_k = (bplan.k_total if bplan is not None
+                        else max(1, int(np.ceil(
+                            cfg.density * self.num_params))))
+                if cfg.compression in (None, "none", "dense"):
+                    fc_k = self.num_params
+                try:
+                    targets = tuple(
+                        int(t) for t in
+                        str(cfg.obs_forecast_targets).split(",")
+                        if t.strip())
+                except ValueError:
+                    raise ValueError(
+                        "--obs-forecast-targets must be a comma-"
+                        "separated list of worker counts, got "
+                        f"{cfg.obs_forecast_targets!r}")
+                self.forecaster = StepForecaster(
+                    {"mode": cfg.compression or "dense", "p": self.p,
+                     "n": self.num_params, "k": fc_k,
+                     "codec": cfg.wire_codec,
+                     "schedule": (d.plan.schedule
+                                  if d is not None else None),
+                     "bucketing": cfg.buckets or "concat",
+                     "buckets": (bplan.pairs()
+                                 if bplan is not None else None),
+                     "ici_size": cfg.hier_ici},
+                    baseline=inputs, targets=targets,
                     metrics=self.metrics, monitor=self.monitor)
         self._eval_step = self._build_eval_step()
         # Degrade fallback (recover-policy "degrade"): the sparse step
@@ -860,17 +916,26 @@ class Trainer:
         overlapped = (self._bucket_plan is not None
                       and self._bucket_plan.pipeline == "overlap")
         t_comm_ms = float(t_comm_us) / 1e3 / spd
-        self.calib.observe(step, wire_bytes=wire,
-                           t_comm_ms=t_comm_ms,
-                           overlapped=overlapped)
+        calib_rec = self.calib.observe(step, wire_bytes=wire,
+                                       t_comm_ms=t_comm_ms,
+                                       overlapped=overlapped)
+        lm_rec = None
         if self.linkmap is not None and not overlapped:
             # Same sample, carved per link; overlapped spans are
             # quarantined here for the same reason the calibrator
             # quarantines them — a partially-hidden t_comm would bias
             # every link's EWMA low. May raise AnomalyHalt (after its
             # durable record), like any monitor-fed surface.
-            self.linkmap.observe(step, t_comm_ms=t_comm_ms,
-                                 wire_bytes=wire)
+            lm_rec = self.linkmap.observe(step, t_comm_ms=t_comm_ms,
+                                          wire_bytes=wire)
+        if self.forecaster is not None:
+            # The forecast reprices itself from whatever this capture
+            # refreshed: a completed refit window's fit, the weather
+            # map's degradation multiple.
+            if calib_rec is not None:
+                self.forecaster.note_calib(calib_rec)
+            if lm_rec is not None:
+                self.forecaster.note_linkmap(lm_rec)
 
     def _log_critpath(self, step: int, spd: int, trace_dir: str,
                       cleanup: bool = True) -> None:
@@ -907,6 +972,11 @@ class Trainer:
             # record just measured (compute->goodput, select/comm/wait
             # ->their badput buckets).
             self.goodput.note_stage_fracs(cp)
+        if self.forecaster is not None:
+            # Per-step compute/select budgets + the measured wall the
+            # hindcast compares against; fed BEFORE the shift rule so
+            # a halt there never starves the forecast of its budgets.
+            self.forecaster.note_critpath(cp, spd=spd)
         # AnomalyHalt from the shift rule propagates like any monitor
         # halt — the durable event record lands before the raise.
         if self.monitor is not None:
@@ -1688,6 +1758,12 @@ class Trainer:
                                        cleanup=not calib_now)
                 if calib_now:
                     self._feed_calibrator(step, spd, trace_dir)
+                if capture_now and self.forecaster is not None:
+                    # One forecast per capture: compose the budgets and
+                    # fit the two feeds above just refreshed into a
+                    # durable "forecast" record, then the drift rule
+                    # (which may raise AnomalyHalt — after the record).
+                    self.forecaster.observe(step)
                 if capture_now and gp is not None:
                     # Host-side trace attribution is observability
                     # overhead — no taxonomy bucket; drop it to `other`

@@ -99,6 +99,12 @@ KINDS = frozenset({
                    # per-round intervals, and the worst-link summary;
                    # fsync'd — written BEFORE the link_degraded rule
                    # can halt the run
+    "forecast",    # scale-out forecast record (obs/forecast.py): the
+                   # hindcast error (predicted vs measured step time on
+                   # THIS run), the per-P-target recommendation grid
+                   # with resid-derived uncertainty bands, and the
+                   # tree->balanced crossover P; fsync'd — written
+                   # BEFORE the forecast_drift rule can halt the run
 })
 
 _SHARD_RE = re.compile(r"^metrics\.rank(\d+)\.jsonl$")

@@ -1,0 +1,98 @@
+"""Regenerate the committed forecast fixture shard in this directory.
+
+One rank-0 shard of a synthetic p=4 gtopk run (steps 1-4 at a 1.0 s
+cadence) carrying every record kind the OFFLINE forecast path
+(obs/forecast.py summarize_forecast, source "stream") composes:
+
+  manifest      compression=gtopk, nworkers=4, num_params=1_000_000,
+                density=0.01 (k = 10_000), wire_codec=fp32,
+                comm_plan_schedule=tree
+  calib         alpha_fit_ms=0.5, beta_fit_gbps=8.0, resid_ms=0.02 —
+                the run's own refit, so fit_source is "calib-record"
+  linkmap       links [1, 1, 1, 2] ms -> degrade_factor = mean/median
+                = 1.25 (the one degraded link priced at its multiple)
+  critpath x4   t_compute_us=10_000, t_select_us=2_000,
+                wall_us=14_795 every capture
+
+All hand arithmetic, chosen so the hindcast is EXACT:
+
+  comm  = tree_rounds(4)=2 DCN rounds x (alpha 0.5 ms + 80_000 set
+          bytes / (8 Gbps -> 1e6 B/ms) = 0.08 ms) = 1.16 ms
+  pred  = 10 + 2 + 1.16 x 1.25 = 13.45 ms
+  meas  = 14.795 ms  ->  err_x = 14.795 / 13.45 = 1.1 exactly
+
+Test assertions in tests/test_forecast.py pin these numbers.
+
+Run from anywhere:  python tests/fixtures/forecast/make_forecast_fixture.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+BASE_TIME = 1700000000.0
+STEP_S = 1.0
+CONFIG_HASH = "forecastfix01beef"
+P, STEPS = 4, (1, 2, 3, 4)
+
+COMPUTE_US = 10_000.0
+SELECT_US = 2_000.0
+WALL_US = 14_795.0   # 1.1x the modeled 13.45 ms step
+ALPHA_MS, BETA_GBPS, RESID_MS = 0.5, 8.0, 0.02
+
+
+def records():
+    yield {
+        "kind": "manifest", "time": BASE_TIME, "rank": 0,
+        "config_hash": CONFIG_HASH,
+        "dnn": "resnet20", "dataset": "cifar10",
+        "compression": "gtopk", "density": 0.01,
+        "num_params": 1_000_000,
+        "nworkers": P, "batch_size": 4, "seed": 42,
+        "wire_codec": "fp32", "comm_plan_schedule": "tree",
+        "process_count": P, "process_index": 0,
+    }
+    yield {
+        "kind": "calib", "time": BASE_TIME + 0.5, "rank": 0,
+        "step": 1, "alpha_fit_ms": ALPHA_MS,
+        "beta_fit_gbps": BETA_GBPS, "resid_ms": RESID_MS,
+        "n_samples": 8,
+    }
+    yield {
+        "kind": "linkmap", "time": BASE_TIME + 0.5, "rank": 0,
+        "step": 1, "wire_mode": "gtopk", "p": P, "n_links": 4,
+        "links": [
+            {"link": "dcn:0-1", "axis": "dcn", "src": 0, "dst": 1,
+             "ewma_ms": 1.0, "n": 1},
+            {"link": "dcn:0-2", "axis": "dcn", "src": 0, "dst": 2,
+             "ewma_ms": 1.0, "n": 1},
+            {"link": "dcn:1-3", "axis": "dcn", "src": 1, "dst": 3,
+             "ewma_ms": 1.0, "n": 1},
+            {"link": "dcn:2-3", "axis": "dcn", "src": 2, "dst": 3,
+             "ewma_ms": 2.0, "n": 1},
+        ],
+    }
+    for step in STEPS:
+        yield {
+            "kind": "critpath", "time": BASE_TIME + step * STEP_S,
+            "rank": 0, "step": step,
+            "wall_us": WALL_US,
+            "t_compute_us": COMPUTE_US,
+            "t_select_us": SELECT_US,
+            "t_comm_us": WALL_US - COMPUTE_US - SELECT_US,
+        }
+
+
+def main() -> None:
+    path = os.path.join(HERE, "metrics.rank0.jsonl")
+    with open(path, "w") as fh:
+        for rec in records():
+            fh.write(json.dumps(rec) + "\n")
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
