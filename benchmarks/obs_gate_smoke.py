@@ -64,6 +64,7 @@ SMOKES = {
     "goodput": ("goodput",),
     "linkmap": ("linkmap",),
     "forecast": ("forecast",),
+    "elastic": ("resize",),
     "lint": ("lint",),
 }
 # Sub-smokes a selected one cannot run without: the plan A/B reuses the
@@ -1153,6 +1154,219 @@ def run_forecast_smoke(out_dir: str) -> dict:
     }
 
 
+def run_elastic_smoke(out_dir: str) -> dict:
+    """Elastic-fleet smoke (the elastic tentpole's consumer): three
+    resize loops of the canonical run under ``--elastic``
+    (resilience/elastic.py), each closed end-to-end — drain, durable
+    "resize" record, exit 46, relaunch in a FRESH out_dir (ckpt +
+    elastic.json copied over, exactly the supervisor contract) at the
+    new --nworkers. Returns the fields the main run logs as ONE
+    "resize" record so the drift gate can pin the PR's acceptance
+    numbers:
+
+      shrink leg (2 -> 1)        resize@3:1 drains at step 3, saves,
+                                 logs exactly ONE "resize" record
+                                 (old_p=2, new_p=1, reason=inject,
+                                 drained_step=3) and exits 46; the
+                                 relaunch restores at P=1 (residual
+                                 folded 2 -> 1) and completes (rc 0)
+                                 with the SAME lineage_id at
+                                 resize_epoch 1. Both registry lines
+                                 carry the lineage, so history renders
+                                 ONE lineage with 2 runs and
+                                 pick_baseline joins the post-resize
+                                 segment to the pre-resize entry
+                                 across the config_hash change
+      grow leg (1 -> 2)          resize@3:2 -> 46 -> relaunch at P=2:
+                                 the comm stack re-derives at the new
+                                 size for free, pinned by the
+                                 post-resize "plan" record scoring at
+                                 p=2 (at p=1 no plan decision exists
+                                 to score)
+      evict leg                  the decision function: a synthetic
+                                 3-rank fleet view whose rank 0 sits
+                                 far below the median goodput_frac
+                                 (dominant badput: wait) with a
+                                 persistent-straggler row — advise()
+                                 names rank 0, eviction_decision
+                                 returns new_p=2 with the straggler
+                                 corroborated, and refuses at
+                                 min_fleet=3 (never below the floor);
+                                 the fleet arithmetic pins the exact
+                                 recovered goodput fraction. The loop
+                                 then closes in the trainer: a 2-way
+                                 run with injected 0.2 s straggler
+                                 stalls and evict_rank:0@3 resizes
+                                 with reason=evict (evicted_ranks=[0])
+                                 -> 46 -> relaunch at P=1 completes,
+                                 and the post-resize goodput_frac
+                                 exceeds the straggler-burdened
+                                 pre-resize one (one-sided indicator)
+
+    Exit codes, record counts, lineage identity, and the synthetic
+    fleet arithmetic are structural (exact pins); the real-timing
+    goodput comparison enters only as the one-sided indicator."""
+    import json as _json
+    import shutil
+
+    from gtopkssgd_tpu import dist_trainer
+    from gtopkssgd_tpu.obs import goodput as _goodput
+    from gtopkssgd_tpu.obs import registry as _registry
+    from gtopkssgd_tpu.resilience import eviction_decision
+
+    canon = [
+        "--dnn", "resnet20", "--batch-size", "4",
+        "--compression", "gtopk_layerwise", "--density", "0.01",
+        "--seed", "42", "--eval-batches", "1", "--log-interval", "1",
+        "--obs-interval", "1",
+    ]
+
+    def _recs(d):
+        with open(os.path.join(d, "metrics.jsonl")) as fh:
+            return [_json.loads(line) for line in fh]
+
+    def _relaunch_dir(src: str, dst: str) -> str:
+        """The supervisor contract: a FRESH out_dir seeded with the
+        checkpoint tree and the lineage file (reusing the old out_dir
+        would corrupt its registry summary — run_summary keys on the
+        FIRST manifest in the stream)."""
+        os.makedirs(dst, exist_ok=True)
+        shutil.copytree(os.path.join(src, "ckpt"),
+                        os.path.join(dst, "ckpt"))
+        shutil.copy2(os.path.join(src, "elastic.json"),
+                     os.path.join(dst, "elastic.json"))
+        return dst
+
+    def _final_goodput_frac(d) -> float:
+        finals = [r for r in _recs(d) if r.get("kind") == "goodput"
+                  and r.get("final")]
+        return float(finals[-1].get("goodput_frac", -1.0)) if finals \
+            else -1.0
+
+    # ---- shrink leg: 2 -> 1 with the registry lineage join.
+    reg_dir = os.path.join(out_dir, "elastic_registry")
+    shrink_a = os.path.join(out_dir, "elastic_shrink")
+    shrink_rc = dist_trainer.main(canon + [
+        "--nworkers", "2", "--elastic", "--inject", "resize@3:1",
+        "--num-iters", "6", "--registry", reg_dir,
+        "--out-dir", shrink_a])
+    resizes = [r for r in _recs(shrink_a) if r.get("kind") == "resize"]
+    rz = resizes[-1] if resizes else {}
+    shrink_b = _relaunch_dir(shrink_a,
+                             os.path.join(out_dir, "elastic_shrink_post"))
+    resume_rc = dist_trainer.main(canon + [
+        "--nworkers", "1", "--elastic", "--resume",
+        "--num-iters", "6", "--registry", reg_dir,
+        "--out-dir", shrink_b])
+    with open(os.path.join(shrink_b, "elastic.json")) as fh:
+        lineage_b = _json.load(fh)
+    entries, _bad = _registry.load_registry(reg_dir)
+    lineages = {e.get("lineage_id") for e in entries
+                if e.get("lineage_id")}
+    joined = (_registry.pick_baseline(entries[-1], entries[:-1])
+              if len(entries) >= 2 else None)
+    hist = _registry.history_rows(
+        entries, config_hash=entries[0].get("config_hash")) \
+        if entries else []
+
+    # ---- grow leg: 1 -> 2, the comm stack re-derived at the new P.
+    grow_a = os.path.join(out_dir, "elastic_grow")
+    grow_rc = dist_trainer.main(canon + [
+        "--nworkers", "1", "--elastic", "--inject", "resize@3:2",
+        "--num-iters", "6", "--out-dir", grow_a])
+    grow_b = _relaunch_dir(grow_a,
+                           os.path.join(out_dir, "elastic_grow_post"))
+    grow_resume_rc = dist_trainer.main(canon + [
+        "--nworkers", "2", "--elastic", "--resume",
+        "--num-iters", "6", "--out-dir", grow_b])
+    grow_plans = [r for r in _recs(grow_b) if r.get("kind") == "plan"]
+    grow_plan_p = float(grow_plans[-1].get("p", -1)) if grow_plans \
+        else -1.0
+
+    # ---- evict leg, decision half: synthetic 3-rank fleet view with
+    # exact arithmetic (no timing noise) — rank 0 far below the median,
+    # wait-dominated, persistent per the straggler plane.
+    by_rank = {
+        0: {"goodput_frac": 0.45, "goodput_s": 45.0, "wait_s": 55.0,
+            "wall_s": 100.0},
+        1: {"goodput_frac": 0.92, "goodput_s": 92.0, "wait_s": 8.0,
+            "wall_s": 100.0},
+        2: {"goodput_frac": 0.95, "goodput_s": 95.0, "wait_s": 5.0,
+            "wall_s": 100.0},
+    }
+    merged = {
+        "goodput_by_rank": by_rank,
+        "stragglers": [{"slowest_rank": 0, "persistent": True,
+                        "ewma_lag_s": 0.4}],
+    }
+    decision = eviction_decision(merged, p=3, min_fleet=1,
+                                 margin=0.02) or {}
+    refused = eviction_decision(merged, p=3, min_fleet=3, margin=0.02)
+    pre_fleet = _goodput.fleet_decomposition(by_rank) or {}
+    post_fleet = _goodput.fleet_decomposition(
+        {r: d for r, d in by_rank.items()
+         if r != decision.get("rank")}) or {}
+    fleet_gain = (float(post_fleet.get("goodput_frac", 0.0))
+                  - float(pre_fleet.get("goodput_frac", 0.0)))
+
+    # ---- evict leg, trainer half: the straggler-burdened pre-resize
+    # run (injected 0.2 s stalls) evicts rank 0 -> 46 -> the clean
+    # post-resize run's goodput_frac must exceed it.
+    evict_a = os.path.join(out_dir, "elastic_evict")
+    evict_rc = dist_trainer.main(canon + [
+        "--nworkers", "2", "--elastic",
+        "--inject", "slow_rank:0:0.2@1-2,evict_rank:0@3",
+        "--num-iters", "6", "--out-dir", evict_a])
+    ev_resizes = [r for r in _recs(evict_a) if r.get("kind") == "resize"]
+    ev = ev_resizes[-1] if ev_resizes else {}
+    pre_frac = _final_goodput_frac(evict_a)
+    evict_b = _relaunch_dir(evict_a,
+                            os.path.join(out_dir, "elastic_evict_post"))
+    evict_resume_rc = dist_trainer.main(canon + [
+        "--nworkers", "1", "--elastic", "--resume",
+        "--num-iters", "6", "--out-dir", evict_b])
+    post_frac = _final_goodput_frac(evict_b)
+
+    return {
+        "shrink_rc": float(shrink_rc),
+        "shrink_resize_records": float(len(resizes)),
+        "shrink_old_p": float(rz.get("old_p", -1)),
+        "shrink_new_p": float(rz.get("new_p", -1)),
+        "shrink_reason_inject": float(rz.get("reason") == "inject"),
+        "shrink_drained_step": float(rz.get("drained_step", -1)),
+        "shrink_resume_rc": float(resume_rc),
+        "lineage_stable": float(
+            bool(rz.get("lineage_id"))
+            and lineage_b.get("lineage_id") == rz.get("lineage_id")),
+        "resize_epoch_resume": float(lineage_b.get("resize_epoch", -1)),
+        "registry_lineages": float(len(lineages)),
+        "registry_runs": float(len(entries)),
+        "regress_lineage_join": float(
+            joined is not None
+            and joined.get("lineage_id") == entries[-1].get("lineage_id")
+            and joined.get("config_hash")
+            != entries[-1].get("config_hash")),
+        "history_rows_joined": float(len(hist)),
+        "grow_rc": float(grow_rc),
+        "grow_resume_rc": float(grow_resume_rc),
+        "grow_post_plan_p": grow_plan_p,
+        "advise_rank": float(decision.get("rank", -1)),
+        "decision_new_p": float(decision.get("new_p", -1)),
+        "decision_persistent": float(
+            bool(decision.get("persistent_straggler"))),
+        "decision_min_fleet_refused": float(refused is None),
+        "fleet_gain_frac": round(fleet_gain, 6),
+        "evict_rc": float(evict_rc),
+        "evict_reason_evict": float(ev.get("reason") == "evict"),
+        "evict_evicted_rank": float(
+            (ev.get("evicted_ranks") or [-1])[0]),
+        "evict_resume_rc": float(evict_resume_rc),
+        "evict_goodput_pre": round(pre_frac, 6),
+        "evict_goodput_post": round(post_frac, 6),
+        "evict_goodput_improved": float(post_frac > pre_frac),
+    }
+
+
 def run_smoke(out_dir: str, only=None) -> str:
     """Train the canonical run; returns the run dir (metrics.jsonl inside).
 
@@ -1209,6 +1423,8 @@ def run_smoke(out_dir: str, only=None) -> str:
                    if _selected("linkmap", only) else None)
     forecast_rec = (run_forecast_smoke(out_dir)
                     if _selected("forecast", only) else None)
+    elastic_rec = (run_elastic_smoke(out_dir)
+                   if _selected("elastic", only) else None)
     critpath_rec = critpath_real = None
     if _selected("critpath", only):
         critpath_rec, critpath_real = run_critpath_smoke(out_dir)
@@ -1317,6 +1533,15 @@ def run_smoke(out_dir: str, only=None) -> str:
         # Durable evidence -> flush=True.
         if forecast_rec is not None:
             t.metrics.log("forecast", flush=True, **forecast_rec)
+        # And the elastic smoke: three closed resize loops (shrink,
+        # grow, evict) — exit-46 contract, exactly-one durable resize
+        # record, lineage identity across the relaunch, the registry's
+        # lineage join, the post-resize plan re-scored at the new P,
+        # and the eviction decision's exact synthetic-fleet arithmetic
+        # with the one-sided post-eviction goodput indicator.
+        # Durable evidence -> flush=True.
+        if elastic_rec is not None:
+            t.metrics.log("resize", flush=True, **elastic_rec)
         # And the critical-path smoke: one REAL per-step stage-interval
         # record from the overlap arm (so the registry's wait_frac /
         # crit_stage_modal path runs on gate data) plus the summary the

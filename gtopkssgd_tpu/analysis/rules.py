@@ -530,7 +530,7 @@ class DurableEventRule:
 
     DURABLE_KINDS = {"event", "inject", "recovery", "calib", "regress",
                      "compile", "overlap", "critpath", "goodput",
-                     "linkmap", "forecast"}
+                     "linkmap", "forecast", "resize"}
 
     def run(self, files: Sequence[SourceFile]) -> List[Finding]:
         findings: List[Finding] = []

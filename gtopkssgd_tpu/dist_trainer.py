@@ -328,12 +328,47 @@ detect-and-recover):
                                          45; resume with --resume
     --allow-ckpt-mismatch                restore past a config_hash/state-
                                          digest integrity mismatch
+    --elastic / --no-elastic             elastic fleet (resilience/
+                                         elastic.py): a membership
+                                         change — preemption, a
+                                         goodput-advised eviction, or
+                                         an injected resize@K:NEWP /
+                                         evict_rank:R@K — drains to a
+                                         step boundary, emergency-saves
+                                         (sidecar meta records the
+                                         residual partition width),
+                                         rewrites out-dir/elastic.json
+                                         (lineage_id + resize_epoch),
+                                         logs a durable "resize"
+                                         record, and exits 46; relaunch
+                                         with --resume --elastic and
+                                         the new --nworkers. The resume
+                                         re-partitions the dp-sharded
+                                         error-feedback residual onto
+                                         the new P (grow = zero rows,
+                                         shrink = masked-fold addition
+                                         conserving the pending
+                                         gradient mass) and re-derives
+                                         planner/bucketing/calibration
+                                         at the new size. Both sides of
+                                         a resize must pass --elastic
+    --evict-after-windows K              elastic: self-check the merged
+                                         per-rank goodput/straggler
+                                         view every K goodput windows
+                                         and evict the rank
+                                         eviction_decision names
+                                         (default 3; 0 disables the
+                                         automatic check)
+    --min-fleet N                        elastic: never resize below N
+                                         workers (default 1; a refused
+                                         preemption-resize falls back
+                                         to classic exit-45 semantics)
 
 Exit codes come from the single-source registry
 ``gtopkssgd_tpu/exit_codes.py`` (0 ok, 43 stall watchdog, 44 anomaly
-halt, 45 preempted-after-save, 99 multihost designed skip — see that
-module for the full table; graftlint's exit-code rule rejects literals
-minted anywhere else).
+halt, 45 preempted-after-save, 46 elastic-resize restart, 99 multihost
+designed skip — see that module for the full table; graftlint's
+exit-code rule rejects literals minted anywhere else).
 
 Summarize or diff the resulting metrics.jsonl with
 ``python -m gtopkssgd_tpu.obs.report <out-dir> [<other-out-dir>]``.
@@ -678,6 +713,28 @@ def build_argparser() -> argparse.ArgumentParser:
                         "state digest disagrees with this run's (normally "
                         "refused: resuming under different flags silently "
                         "changes the experiment)")
+    p.add_argument("--elastic", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="elastic fleet (resilience/elastic.py): treat "
+                        "membership changes (preemption, goodput-"
+                        "advised eviction, injected resize@K:NEWP) as "
+                        "a drain + checkpoint + lineage rewrite + exit "
+                        "46 resize instead of run death; relaunch with "
+                        "--resume --elastic at the new --nworkers and "
+                        "the dp-sharded residual is re-partitioned "
+                        "onto the new fleet (both sides of a resize "
+                        "need this flag)")
+    p.add_argument("--evict-after-windows", type=int, default=3,
+                   help="elastic: self-check the merged per-rank "
+                        "goodput/straggler view every this-many "
+                        "--obs-goodput-interval windows and evict the "
+                        "rank eviction_decision names (0 disables the "
+                        "automatic check; injected evict_rank:R@K "
+                        "still works)")
+    p.add_argument("--min-fleet", type=int, default=1,
+                   help="elastic: never resize below this many workers "
+                        "(a preemption-resize that would falls back to "
+                        "classic exit-45 preempt semantics)")
     p.add_argument("--preempt-save", action=argparse.BooleanOptionalAction,
                    default=True,
                    help="intercept SIGTERM/SIGINT: forced step-granular "
@@ -761,6 +818,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         inject=args.inject,
         recover_policy=args.recover_policy,
         allow_ckpt_mismatch=args.allow_ckpt_mismatch,
+        elastic=args.elastic,
+        evict_after_windows=args.evict_after_windows,
+        min_fleet=args.min_fleet,
         prefetch=args.prefetch,
         decode_workers=args.decode_workers,
     )
@@ -771,10 +831,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     enable_compilation_cache()
     args = build_argparser().parse_args(argv)
+    from gtopkssgd_tpu.exit_codes import EXIT_RESIZE_RESTART
     from gtopkssgd_tpu.resilience import (
         PREEMPT_EXIT_CODE,
         Preempted,
         PreemptionGuard,
+        ResizeRestart,
         describe_policy,
         retry_call,
     )
@@ -826,6 +888,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             trainer.logger.warning("preempted: %s", why)
             trainer.finalize_resilience("preempted")
             return PREEMPT_EXIT_CODE
+        except ResizeRestart as why:
+            # Checkpoint, lineage file, and the durable "resize" record
+            # all landed before the raise (_resize_now's contract); the
+            # exit code tells the supervisor to relaunch at the new P
+            # with --resume --elastic and the new --nworkers.
+            trainer.logger.warning("elastic resize: %s", why)
+            trainer.finalize_resilience("resized")
+            return EXIT_RESIZE_RESTART
         finally:
             if guard is not None:
                 guard.close()

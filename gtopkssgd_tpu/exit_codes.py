@@ -32,6 +32,13 @@ EXIT_ANOMALY_HALT = 44       # --obs-halt-on anomaly fail-fast
 EXIT_PREEMPTED = 45          # SIGTERM/SIGINT intercepted, emergency
                              # checkpoint durable; relaunch with
                              # --resume (resilience/preempt.py)
+EXIT_RESIZE_RESTART = 46     # coordinated elastic resize: state drained
+                             # + checkpointed, lineage file rewritten;
+                             # relaunch with --resume --elastic on the
+                             # new process set (resilience/elastic.py) —
+                             # distinct from 45, which means "this
+                             # process was told to die", not "the fleet
+                             # is re-forming"
 EXIT_MULTIHOST_SKIP = 99     # multi-process probe unsupported on this
                              # build (tests/test_multihost.py,
                              # benchmarks/dcn_probe.py: designed skip,
@@ -47,6 +54,8 @@ REGISTRY = {
     EXIT_ANOMALY_HALT: "anomaly monitor fail-fast (--obs-halt-on)",
     EXIT_PREEMPTED: "preempted after emergency checkpoint "
                     "(resume with --resume)",
+    EXIT_RESIZE_RESTART: "elastic resize: checkpoint + lineage durable "
+                         "(relaunch with --resume --elastic on new P)",
     EXIT_MULTIHOST_SKIP: "multi-process probe unsupported: "
                          "designed skip",
 }

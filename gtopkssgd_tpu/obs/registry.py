@@ -32,9 +32,12 @@ REGISTRY_NAME = "runs.jsonl"
 
 # Manifest keys copied into each entry: config_hash keys comparability,
 # the rest make a registry line readable without the run directory.
+# lineage_id/resize_epoch (elastic runs only — resilience/elastic.py)
+# join the pre/post segments of a resized run into ONE trajectory even
+# though the config_hash changes with --nworkers.
 _MANIFEST_KEYS = ("config_hash", "git_sha", "dnn", "dataset",
                   "compression", "density", "wire_codec", "nworkers",
-                  "batch_size", "seed")
+                  "batch_size", "seed", "lineage_id", "resize_epoch")
 
 # Regression checks: (field, rtol, atol). Gate tolerance semantics —
 # FAIL when |current - baseline| > atol + rtol*|baseline|. Throughput
@@ -335,10 +338,17 @@ def history_rows(entries: Sequence[Dict[str, Any]],
                  config_hash: Optional[str] = None
                  ) -> List[List[str]]:
     """Trend-table rows (newest last) for ``report history``; filtered
-    to one config_hash when given."""
+    to one config_hash when given. The filter follows elastic lineage:
+    an entry whose lineage_id matches any hash-matched entry's is kept
+    too, so a resized run's pre/post segments (different --nworkers,
+    hence different config_hash) render as one trajectory."""
+    lineages = {e.get("lineage_id") for e in entries
+                if config_hash and e.get("config_hash") == config_hash
+                and e.get("lineage_id")}
     rows = []
     for e in entries:
-        if config_hash and e.get("config_hash") != config_hash:
+        if config_hash and e.get("config_hash") != config_hash and not (
+                e.get("lineage_id") and e.get("lineage_id") in lineages):
             continue
         stats = e.get("stats") or {}
         # Compact per-axis fit cell: "dcn:21.9/2.1 ici:0.1/1600" —
@@ -371,6 +381,10 @@ def history_rows(entries: Sequence[Dict[str, Any]],
             _cell(stats.get("goodput_frac")),
             _cell(stats.get("hindcast_err_x")),
             str(stats.get("forecast_rec_p256", "-")),
+            # "lid8:epoch" for elastic runs — the join key that groups
+            # a resized run's segments; "-" for classic runs.
+            (f"{str(e['lineage_id'])[:8]}:{e.get('resize_epoch', 0)}"
+             if e.get("lineage_id") else "-"),
             str(stats.get("final_status", "-")),
         ])
     return rows
@@ -381,7 +395,7 @@ HISTORY_HEADER = ["config", "git", "steps", "steps/s", "loss",
                   "recall", "wireB/step", "peak_hbm", "recomp",
                   "pipeline", "B", "ovl_frac", "crit_stage",
                   "wait_frac", "goodput", "hindcast", "fc_p256",
-                  "status"]
+                  "lineage", "status"]
 
 
 def pick_baseline(entry: Dict[str, Any],
@@ -390,12 +404,22 @@ def pick_baseline(entry: Dict[str, Any],
                   ) -> Optional[Dict[str, Any]]:
     """Most recent registry entry with the current run's config_hash
     (comparing runs of different configurations is apples-to-oranges —
-    opt in explicitly with allow_mismatch)."""
+    opt in explicitly with allow_mismatch). Elastic exception: an entry
+    sharing the run's lineage_id is the SAME logical run on a different
+    fleet size, so it baselines a post-resize segment without
+    allow_mismatch — size-dependent fields (wire bytes, fits) drift and
+    should be read with that in mind, but loss/recall continuity is
+    exactly what the lineage join exists to check."""
     want = entry.get("config_hash")
     matches = [e for e in entries
                if want is not None and e.get("config_hash") == want]
     if matches:
         return matches[-1]
+    lid = entry.get("lineage_id")
+    kin = [e for e in entries
+           if lid is not None and e.get("lineage_id") == lid]
+    if kin:
+        return kin[-1]
     if allow_mismatch and entries:
         return entries[-1]
     return None

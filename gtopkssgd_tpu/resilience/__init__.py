@@ -21,6 +21,15 @@ provable in CI on a CPU mesh:
       *degrade* (fall back from sparse to dense allreduce, re-entering
       sparse after a cooldown). Every action is a registered "recovery"
       record.
+  elastic.py — elastic fleet resize (``--elastic``): a membership
+      change (preemption, straggler eviction via goodput ``advise()``,
+      or an injected ``resize@K:NEWP``) drains to a step boundary,
+      emergency-saves, re-partitions the dp-sharded error-feedback
+      residual onto the new P (grow = zero rows, shrink = masked-fold
+      addition conserving pending gradient mass), rewrites the
+      ``elastic.json`` lineage file, logs a durable "resize" record,
+      and exits 46 for the supervisor to relaunch at the new size —
+      one logical run, one registry lineage.
   preempt.py — SIGTERM/SIGINT preemption guard (flag-setting handlers;
       the trainer turns the flag into a forced step-granular emergency
       save then ``Preempted`` -> exit 45; 43=stall and 44=halt stay
@@ -35,6 +44,15 @@ recovery that drops or duplicates residual state is silently wrong) is
 what the skip/rollback semantics here are designed around.
 """
 
+from gtopkssgd_tpu.resilience.elastic import (
+    ResizeRestart,
+    eviction_decision,
+    load_lineage,
+    mint_lineage_id,
+    repartition_buffer,
+    repartition_residual,
+    write_lineage,
+)
 from gtopkssgd_tpu.resilience.inject import (
     Fault,
     FaultInjector,
@@ -63,8 +81,15 @@ __all__ = [
     "Preempted",
     "PreemptionGuard",
     "RecoveryManager",
+    "ResizeRestart",
     "describe_policy",
+    "eviction_decision",
+    "load_lineage",
+    "mint_lineage_id",
     "parse_inject",
     "parse_policy",
+    "repartition_buffer",
+    "repartition_residual",
     "retry_call",
+    "write_lineage",
 ]

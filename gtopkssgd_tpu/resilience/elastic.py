@@ -1,0 +1,221 @@
+"""Elastic fleet: resize the dp mesh without losing a step.
+
+The paper's premise (arXiv:1901.04059) is a slow, unreliable network;
+the production extreme of that premise is a fleet whose MEMBERSHIP is
+unreliable — spot capacity preempted mid-run, a persistently slow host
+worth evicting, capacity arriving late. PRs 5-19 made a membership
+change survivable (exit 45 + --resume restores the SAME P); this module
+makes it a bounded-cost *resize*: the run drains to a step boundary,
+emergency-saves through the existing integrity-sidecar path, and
+relaunches on a DIFFERENT process set with nothing lost.
+
+The resize protocol (trainer._resize_now + dist_trainer):
+
+  trigger            preemption signal (PreemptionGuard), an eviction
+                     decision (``eviction_decision`` below, fed by the
+                     fleet merge's per-rank goodput + straggler EWMA),
+                     or an injected ``resize@K:NEWP`` fault
+  drain              the trigger is only acted on at the train loop's
+                     iteration boundary, where the state is whole
+  save               orbax force=True at the drained step; the
+                     integrity sidecar additionally records the
+                     residual's partition width (``meta.residual_p``)
+                     so the restoring side knows the OLD P without
+                     guessing from shapes
+  lineage            ``elastic.json`` in out_dir is atomically
+                     rewritten with resize_epoch+1 and the new P, and
+                     one fsync'd "resize" metrics record lands —
+                     BEFORE any process exits
+  exit 46            ResizeRestart -> EXIT_RESIZE_RESTART. The relaunch
+                     contract mirrors preempt-45: an external
+                     supervisor re-invokes dist_trainer with --resume
+                     --elastic and the new --nworkers;
+                     jax.distributed.initialize then runs on the new
+                     process set, and Trainer.__init__ re-derives the
+                     whole comm stack at the new P for free (the PR 9
+                     planner re-scores the CommPlan, the PR 11
+                     bucketing DP re-runs, the PR 13 calibrator
+                     re-fits — all are functions of P)
+
+State re-partitioning: every replicated leaf (params, momentum, step)
+restores shape-identically. The one P-shaped leaf is the error-feedback
+residual ([P, ...] sharded P('dp')); ``repartition_residual`` re-splits
+it host-side. Growing appends zero rows (a new worker starts with an
+empty residual, exactly like step 0); shrinking FOLDS each orphaned row
+into a surviving one by addition — the same masked-fold move
+parallel/collectives.py uses for non-pow2 merges (extra m+t sends its
+set down to participant t), iterated for arbitrary shrink factors. The
+fold is the error-feedback-correct choice: the residual is exactly the
+gradient mass not yet applied, so adding orphaned rows into survivors
+conserves the pending mass column-for-column — nothing is silently
+dropped, mirroring how rejected picks fold back after every merge
+(arXiv:1911.08772 ties convergence to precisely this bookkeeping).
+Re-partitioning is a state-redistribution problem of the kind
+arXiv:2112.01075 decomposes into portable collectives; at the
+checkpoint boundary the whole exchange degenerates to this host-side
+gather + re-split.
+
+Lineage continuity: ``lineage_id`` is minted once per LOGICAL run and
+carried across every resize via ``elastic.json`` (copied next to the
+checkpoint dir into each relaunch's out_dir); the run manifest and
+registry entry carry lineage_id/resize_epoch so ``report history`` and
+``report regress`` join the pre/post segments into one trajectory
+(obs/registry.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from typing import Any, Dict, Mapping, Optional, Sequence
+
+import numpy as np
+
+LINEAGE_FILE = "elastic.json"
+
+
+class ResizeRestart(RuntimeError):
+    """Raised by the trainer once the resize checkpoint + lineage file
+    + durable "resize" record are on disk; dist_trainer maps it to
+    EXIT_RESIZE_RESTART (46) so the supervisor relaunches the fleet at
+    the new P with --resume --elastic."""
+
+
+# ------------------------------------------------------------- lineage
+
+def mint_lineage_id() -> str:
+    """Fresh lineage id for a LOGICAL run (stable across resizes)."""
+    return uuid.uuid4().hex[:16]
+
+
+def lineage_path(out_dir: str) -> str:
+    return os.path.join(out_dir, LINEAGE_FILE)
+
+
+def load_lineage(out_dir: Optional[str]) -> Optional[Dict[str, Any]]:
+    """The lineage state carried into this run, or None for a fresh
+    (or non-elastic) start. Malformed files read as None — a torn
+    lineage must not kill a resume that the checkpoint itself allows;
+    the run then starts a new lineage, which history renders as two."""
+    if not out_dir:
+        return None
+    try:
+        with open(lineage_path(out_dir)) as fh:
+            rec = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) and rec.get("lineage_id") else None
+
+
+def write_lineage(out_dir: str, **fields: Any) -> Dict[str, Any]:
+    """Atomically write ``elastic.json`` (tmp + fsync + replace — the
+    same no-torn-sidecar discipline as checkpoint integrity files).
+    Returns the record written."""
+    os.makedirs(out_dir, exist_ok=True)
+    path = lineage_path(out_dir)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(fields, fh, sort_keys=True)
+        fh.write("\n")
+        fh.flush()
+        try:
+            os.fsync(fh.fileno())
+        except OSError:
+            pass
+    os.replace(tmp, path)
+    return dict(fields)
+
+
+# ------------------------------------------------------- repartitioning
+
+def repartition_buffer(buf: np.ndarray, new_p: int) -> np.ndarray:
+    """Re-split one per-device buffer [old_p, ...] onto new_p rows.
+
+    Grow: surviving rows are copied bit-exactly; new workers get zero
+    rows (an empty residual, exactly like step 0 — their first top-k
+    round starts accumulating from live gradients).
+
+    Shrink: orphaned row r folds into survivor r % new_p by addition —
+    the iterated form of the collectives' masked fold (extra m+t sends
+    its set down to participant t). Column sums are conserved in exact
+    arithmetic, so no pending gradient mass is dropped; fp32 rounding
+    on the adds is the same bounded perturbation every error-feedback
+    merge already absorbs.
+    """
+    buf = np.asarray(buf)
+    if buf.ndim < 1:
+        raise ValueError("residual buffer must carry a leading [P] dim")
+    old_p = buf.shape[0]
+    if new_p < 1:
+        raise ValueError(f"new_p must be >= 1, got {new_p}")
+    if new_p == old_p:
+        return buf.copy()
+    if new_p > old_p:
+        out = np.zeros((new_p,) + buf.shape[1:], dtype=buf.dtype)
+        out[:old_p] = buf
+        return out
+    out = buf[:new_p].copy()
+    for r in range(new_p, old_p):
+        out[r % new_p] += buf[r]
+    return out
+
+
+def repartition_residual(residual: Any, new_p: int) -> Any:
+    """Tree-mapped ``repartition_buffer`` over any residual layout: the
+    flat [P, N] leaf (gtopk), the per-leaf tuple (gtopk_layerwise), or
+    the {"v": ..., "u": ...} dict (momentum correction)."""
+    import jax
+
+    return jax.tree.map(
+        lambda b: repartition_buffer(np.asarray(b), new_p), residual)
+
+
+# ------------------------------------------------------------- eviction
+
+def eviction_decision(merged: Mapping[str, Any], *, p: int,
+                      min_fleet: int = 1, margin: float = 0.1
+                      ) -> Optional[Dict[str, Any]]:
+    """Decide whether the merged fleet view justifies evicting a rank.
+
+    ``merged`` is obs/fleet.py ``merge()``'s dict. The goodput ledger's
+    ``advise()`` names the rank whose goodput_frac sits furthest below
+    the fleet median by more than ``margin`` (the ROADMAP item-1
+    eviction hint); the straggler rows corroborate with the per-rank
+    EWMA-lag persistence verdict when they cover the same rank. Returns
+    None (no eviction) for a healthy fleet, a fleet already at
+    ``min_fleet``, or a single-rank fleet — shrinking below min_fleet
+    can never be advised. Otherwise:
+
+      {rank, new_p, reason: "evict", source, goodput_frac,
+       fleet_median_frac, dominant_badput, persistent_straggler}
+    """
+    from gtopkssgd_tpu.obs import goodput as _goodput
+
+    if p - 1 < max(1, min_fleet):
+        return None
+    by_rank = merged.get("goodput_by_rank") or {}
+    hint = _goodput.advise(by_rank, margin=margin)
+    if hint is None:
+        return None
+    rank = int(hint["rank"])
+    persistent = any(
+        row.get("slowest_rank") == rank and row.get("persistent")
+        for row in merged.get("stragglers") or [])
+    return {
+        "rank": rank,
+        "new_p": p - 1,
+        "reason": "evict",
+        "source": "goodput_advise",
+        "goodput_frac": hint.get("goodput_frac"),
+        "fleet_median_frac": hint.get("fleet_median_frac"),
+        "dominant_badput": hint.get("dominant_badput"),
+        "persistent_straggler": bool(persistent),
+    }
+
+
+def surviving_ranks(old_p: int, evicted: Sequence[int]) -> list:
+    """The ranks that re-form the fleet after evicting ``evicted`` —
+    the relaunch contract renumbers them densely in order."""
+    gone = set(int(r) for r in evicted)
+    return [r for r in range(old_p) if r not in gone]

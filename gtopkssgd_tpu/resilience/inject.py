@@ -47,6 +47,20 @@ Kinds:
                       Point faults fire once; the next dispatch is back
                       to the canonical shape. A range re-fires per step
                       in the window (sustained storm).
+  resize@K:NEWP       elastic-resize request at the step-K boundary:
+                      the trainer drains, emergency-saves, rewrites the
+                      lineage file for NEWP workers, and unwinds via
+                      ResizeRestart -> exit 46 (resilience/elastic.py).
+                      WHEN carries the target fleet size (point fault
+                      only — a fleet cannot re-form per-step). Requires
+                      --elastic; without it the firing records and
+                      warns but training continues.
+  evict_rank:R@K      eviction-resize request at the step-K boundary:
+                      the chaos stand-in for a goodput-advised
+                      straggler eviction — same drain/save/exit-46
+                      path as resize with reason=evict, new_p = P-1,
+                      evicted_ranks=[R]. Point fault only; requires
+                      --elastic.
 
 Every firing logs one fsync'd "inject" record (fault, step, detail), so
 ``report recovery`` can line injected faults up against the recovery
@@ -62,7 +76,7 @@ import time
 from typing import Any, List, Optional, Tuple
 
 KINDS = ("nan_grad", "slow_rank", "loader_raise", "preempt", "corrupt_ckpt",
-         "reshape")
+         "reshape", "resize", "evict_rank")
 
 # WHEN == "latest" sentinel (corrupt_ckpt: fires at the next restore).
 LATEST = -1
@@ -97,6 +111,10 @@ class Fault:
         return lo if lo <= hi else None
 
     def spec(self) -> str:
+        if self.kind == "resize":
+            # canonical grammar puts the target P after the step:
+            # resize@K:NEWP (args holds NEWP; see parse_inject)
+            return f"resize@{self.start}:{self.args[0]}"
         head = ":".join((self.kind,) + self.args)
         if self.start == LATEST:
             return f"{head}@latest"
@@ -133,6 +151,26 @@ def parse_inject(spec: str) -> List[Fault]:
                 raise ValueError(
                     f"@latest only applies to corrupt_ckpt, not {kind!r}")
             start = end = LATEST
+        elif kind == "resize":
+            # resize@K:NEWP — the WHEN carries the target fleet size,
+            # so the generic STEP|A-B parse below does not apply.
+            if args:
+                raise ValueError(
+                    f"resize takes no ':' args before '@'; the target P "
+                    f"goes after the step (resize@K:NEWP), got {frag!r}")
+            lo, sep, newp = when.partition(":")
+            try:
+                start = end = int(lo)
+                new_p = int(newp) if sep else 0
+            except ValueError:
+                raise ValueError(
+                    f"inject fault {frag!r}: resize WHEN must be "
+                    "STEP:NEW_P (e.g. resize@3:1)") from None
+            if not sep or start < 1 or new_p < 1:
+                raise ValueError(
+                    f"inject fault {frag!r}: resize needs STEP >= 1 "
+                    "and NEW_P >= 1 (grammar resize@K:NEWP)")
+            args = (str(new_p),)
         else:
             lo, sep, hi = when.partition("-")
             try:
@@ -156,6 +194,25 @@ def parse_inject(spec: str) -> List[Fault]:
                     f"slow_rank needs RANK:DURATION args, got {frag!r}")
             int(args[0])
             _parse_duration(args[1])
+        elif kind == "evict_rank":
+            if len(args) != 1:
+                raise ValueError(
+                    f"evict_rank needs a RANK arg, got {frag!r}")
+            try:
+                rank = int(args[0])
+            except ValueError:
+                raise ValueError(
+                    f"evict_rank RANK must be an int, got {frag!r}"
+                ) from None
+            if rank < 0:
+                raise ValueError(
+                    f"evict_rank RANK must be >= 0, got {frag!r}")
+            if start != end:
+                raise ValueError(
+                    f"evict_rank is a point fault (a fleet re-forms "
+                    f"once, not per-step), got {frag!r}")
+        elif kind == "resize":
+            pass  # args minted from the WHEN parse above
         elif args:
             raise ValueError(f"{kind} takes no ':' args, got {frag!r}")
         faults.append(Fault(kind=kind, start=start, end=end, args=args))
@@ -268,6 +325,27 @@ class FaultInjector:
                 continue
             self._record(f, at)
             os.kill(os.getpid(), signal.SIGTERM)
+
+    def pending_resize(self, prev: int, new: int) -> Optional[int]:
+        """Step-boundary check: resize@K:NEW_P. Returns the target
+        fleet size when a resize fault fires in (prev, new], else None.
+        The durable "inject" record lands here, BEFORE the trainer's
+        drain/save/unwind — the process exits 46 shortly after."""
+        for f, at in self._active("resize", prev, new):
+            new_p = int(f.args[0])
+            self._record(f, at, new_p=new_p)
+            return new_p
+        return None
+
+    def pending_evict(self, prev: int, new: int) -> Optional[int]:
+        """Step-boundary check: evict_rank:R@K — the chaos stand-in for
+        a goodput-advised straggler eviction. Returns the rank to
+        evict, else None."""
+        for f, at in self._active("evict_rank", prev, new):
+            rank = int(f.args[0])
+            self._record(f, at, evicted_rank=rank)
+            return rank
+        return None
 
     def maybe_corrupt_ckpt(self, directory: Optional[str]) -> bool:
         """Restore-time: corrupt_ckpt@latest. Truncates every payload

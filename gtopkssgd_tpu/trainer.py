@@ -228,6 +228,29 @@ class TrainConfig:
                                    # disagrees with this run's (the
                                    # explicit escape hatch; normally a
                                    # mismatched resume is refused)
+    elastic: bool = False          # elastic fleet (resilience/
+                                   # elastic.py): membership changes
+                                   # (preemption, eviction, injected
+                                   # resize@K:NEWP) drain + save +
+                                   # rewrite the elastic.json lineage
+                                   # + exit 46 for a relaunch at the
+                                   # new P; resume re-partitions the
+                                   # dp-sharded residual onto the new
+                                   # mesh. BOTH sides of a resize must
+                                   # run with elastic on (the ckpt
+                                   # config_hash nulls nworkers only
+                                   # under this flag)
+    evict_after_windows: int = 3   # elastic: self-check the fleet's
+                                   # merged goodput/straggler view
+                                   # every this-many obs_goodput
+                                   # windows and evict the rank
+                                   # eviction_decision names (0
+                                   # disables the automatic check;
+                                   # injected evict_rank still works)
+    min_fleet: int = 1             # elastic: never resize below this
+                                   # many workers (an eviction or
+                                   # shrink that would is refused and
+                                   # degrades to preempt semantics)
     prefetch: int = 2              # host batches assembled ahead by a
                                    # background thread (0 = synchronous;
                                    # reference C8 parity with DataLoader
@@ -714,6 +737,24 @@ class Trainer:
                 self.goodput.mark("compile")
             if self.memwatch.peak_hbm_bytes is not None:
                 plan_extra["peak_hbm_bytes"] = self.memwatch.peak_hbm_bytes
+        # Elastic lineage (resilience/elastic.py): one LOGICAL run =
+        # one lineage_id, carried across resizes via out_dir's
+        # elastic.json — adopted when the relaunch finds one, minted
+        # fresh otherwise. Stamped into the manifest ONLY under
+        # cfg.elastic so non-elastic manifests stay byte-stable.
+        self.lineage = None
+        if cfg.elastic:
+            from gtopkssgd_tpu.resilience.elastic import (
+                load_lineage, mint_lineage_id, write_lineage)
+            self.lineage = load_lineage(cfg.out_dir)
+            if self.lineage is None:
+                self.lineage = {"lineage_id": mint_lineage_id(),
+                                "resize_epoch": 0, "p": self.p}
+                if cfg.out_dir:
+                    write_lineage(cfg.out_dir, **self.lineage)
+            plan_extra["lineage_id"] = self.lineage["lineage_id"]
+            plan_extra["resize_epoch"] = int(
+                self.lineage.get("resize_epoch", 0))
         # Run-manifest header: first record of every metrics file, so
         # each is self-describing (config hash + resolved headline flags,
         # mesh, jax/backend versions, git sha). In sharded multi-process
@@ -827,9 +868,24 @@ class Trainer:
         # perturbs execution, never the checkpointable state treedef), and
         # a chaos run that could not be resumed without --inject would
         # defeat the preempt/resume path it exists to test.
-        ckpt_hash = config_hash(dataclasses.replace(
-            cfg, inject=None, recover_policy=None,
-            allow_ckpt_mismatch=False))
+        nulled = dict(inject=None, recover_policy=None,
+                      allow_ckpt_mismatch=False)
+        if cfg.elastic:
+            # A resize changes nworkers and NOTHING else about the
+            # experiment, so pre- and post-resize checkpoints must
+            # agree on config_hash: under --elastic the fleet size and
+            # the elastic knobs are nulled too (which is why BOTH sides
+            # of a resize must run with --elastic — a non-elastic
+            # resume of an elastic checkpoint is refused as a
+            # different experiment, by design).
+            # out_dir/registry are workspace plumbing, not experiment
+            # identity — and the relaunch contract puts the resumed run
+            # in a FRESH out_dir (reusing the old one would corrupt its
+            # registry summary), so they cannot key the ckpt hash.
+            nulled.update(nworkers=0, elastic=False,
+                          evict_after_windows=3, min_fleet=1,
+                          out_dir=None, registry=None)
+        ckpt_hash = config_hash(dataclasses.replace(cfg, **nulled))
         self._ckpt = (
             CheckpointManager(f"{cfg.out_dir}/ckpt",
                               config_hash=ckpt_hash,
@@ -1662,8 +1718,14 @@ class Trainer:
                 # Preemption flag check at the iteration boundary: the
                 # signal handler (resilience/preempt.py) only sets the
                 # flag; the emergency save + unwind happen HERE, where
-                # the state is whole.
+                # the state is whole. Under --elastic a preemption is a
+                # RESIZE to P-1 (the fleet re-forms without the lost
+                # capacity) unless that would shrink below min_fleet,
+                # in which case _resize_now falls back to exit-45
+                # preempt semantics.
                 if guard is not None and guard.triggered:
+                    if cfg.elastic:
+                        self._resize_now(self.p - 1, reason="preempt")
                     self._preempt_now()
                 # Degrade cooldown expiry: re-enter the sparse step.
                 if self._degraded and step >= self._degrade_until:
@@ -1774,7 +1836,13 @@ class Trainer:
                     # the installed guard; the flag check right after
                     # makes the firing step-deterministic.
                     inj.maybe_preempt(step - spd, step, guard)
+                    # resize@K:NEWP / evict_rank:R@K fire at the same
+                    # post-dispatch boundary (durable "inject" record
+                    # either way; no-op warning without --elastic).
+                    self._check_injected_resize(step - spd, step)
                 if guard is not None and guard.triggered:
+                    if cfg.elastic:
+                        self._resize_now(self.p - 1, reason="preempt")
                     self._preempt_now()
                 synced = False
                 # On-device counters (obs.counters, carried in
@@ -1882,6 +1950,19 @@ class Trainer:
                     # paid. AnomalyHalt propagates AFTER the record is
                     # durable, like every monitor halt.
                     gp.tick(step)
+                    # Elastic eviction self-check, every
+                    # evict_after_windows goodput windows (rank 0 — it
+                    # owns the merged fleet view): a persistently
+                    # underperforming rank named by goodput advise()
+                    # triggers the evict resize path.
+                    if (cfg.elastic and cfg.evict_after_windows > 0
+                            and cfg.obs_goodput_interval > 0
+                            and cfg.out_dir
+                            and self.process_rank == 0
+                            and step % (cfg.obs_goodput_interval
+                                        * cfg.evict_after_windows)
+                            < spd):
+                        self._maybe_evict(step)
             # true_sync, not block_until_ready: the tunneled TPU platform
             # acks readiness before execution completes (utils/timers.py).
             from gtopkssgd_tpu.utils import true_sync
@@ -2079,7 +2160,11 @@ class Trainer:
         residual spans non-addressable devices) and was how round 1 lost
         every rank-but-0 residual."""
         if self._ckpt is not None:
-            self._ckpt.save(int(self.state.step), self.state)
+            # meta.residual_p: the residual's partition width, so an
+            # elastic different-P resume can build the OLD-shape
+            # template without guessing (utils/checkpoint.py sidecar).
+            self._ckpt.save(int(self.state.step), self.state,
+                            meta={"residual_p": self.p})
             if self.goodput is not None:
                 self.goodput.mark("ckpt")
 
@@ -2095,9 +2180,20 @@ class Trainer:
         # mesh for params/step/momentum, P('dp') for the per-device
         # residual (no dense single-device materialization, and every
         # process of a multi-host run reads only its own residual shards).
-        self.state = self._ckpt.restore(
-            self._state_template(),
-            allow_mismatch=self.cfg.allow_ckpt_mismatch)
+        # Elastic resumes first consult the sidecar's residual_p: a
+        # checkpoint saved at a DIFFERENT fleet size takes the
+        # re-partitioning path instead of the shape-identical one.
+        old_p = 0
+        if self.cfg.elastic:
+            old_p = int(self._ckpt.sidecar_meta().get("residual_p") or 0)
+        if (old_p and old_p != self.p
+                and getattr(self.state.opt_state, "residual", None)
+                is not None):
+            self.state = self._restore_resized(old_p)
+        else:
+            self.state = self._ckpt.restore(
+                self._state_template(),
+                allow_mismatch=self.cfg.allow_ckpt_mismatch)
         step = int(self.state.step)
         self.logger.info("restored step %d from %s", step,
                          self._ckpt.directory)
@@ -2113,6 +2209,59 @@ class Trainer:
             self.goodput.mark("ckpt")
         return True
 
+    def _restore_resized(self, old_p: int):
+        """Elastic restore across a fleet resize: the checkpoint's
+        residual is partitioned over ``old_p`` rows, this run's over
+        ``self.p``. Build a template in the SAVED shape — replicated,
+        since old_p need not divide the new mesh — so the integrity
+        digest verifies against what was actually written, then
+        re-partition the residual host-side (resilience/elastic.py:
+        grow = zero rows, shrink = masked-fold addition conserving the
+        pending gradient mass) and commit it onto the new mesh's
+        P('dp') placement. Every other leaf restores shape-identically."""
+        from jax.sharding import NamedSharding
+
+        from gtopkssgd_tpu.resilience.elastic import repartition_buffer
+
+        rep = NamedSharding(self.mesh, P())
+
+        def leaf(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=rep)
+
+        template = jax.tree.map(leaf, self.state)
+
+        def old_leaf(r):
+            # live residual: [p, ...] rows when p > 1, bare at p == 1;
+            # the saved one followed the same convention at old_p
+            body = r.shape[1:] if self.p > 1 else r.shape
+            shape = ((old_p,) + tuple(body)) if old_p > 1 else tuple(body)
+            return jax.ShapeDtypeStruct(shape, r.dtype, sharding=rep)
+
+        template = template._replace(opt_state=template.opt_state._replace(
+            residual=jax.tree.map(old_leaf,
+                                  self.state.opt_state.residual)))
+        restored = self._ckpt.restore(
+            template, allow_mismatch=self.cfg.allow_ckpt_mismatch)
+        dp = NamedSharding(self.mesh, P("dp"))
+
+        def repartition(saved):
+            buf = np.asarray(saved)
+            if old_p == 1:
+                buf = buf[None]
+            out = repartition_buffer(buf, max(1, self.p))
+            if self.p == 1:
+                return jnp.asarray(out[0])
+            return jax.make_array_from_callback(
+                out.shape, dp, lambda idx, o=out: o[idx])
+
+        restored = restored._replace(opt_state=restored.opt_state._replace(
+            residual=jax.tree.map(repartition,
+                                  restored.opt_state.residual)))
+        self.logger.warning(
+            "elastic restore: residual re-partitioned %d -> %d rows "
+            "(pending gradient mass conserved)", old_p, self.p)
+        return restored
+
     # ---------------------------------------------------------- resilience
     def _preempt_now(self) -> None:
         """The preemption flag is set: force a step-granular emergency
@@ -2123,7 +2272,8 @@ class Trainer:
 
         step = int(self.state.step)  # blocks: the save must be post-step
         if self._ckpt is not None:
-            self._ckpt.save(step, self.state, force=True)
+            self._ckpt.save(step, self.state, force=True,
+                            meta={"residual_p": self.p})
             if self.goodput is not None:
                 # The emergency save is the preempt fault's designated
                 # badput: ckpt.
@@ -2138,6 +2288,116 @@ class Trainer:
                 "preemption at step %d with no out_dir: nothing saved",
                 step)
         raise Preempted(f"preemption signal at step {step}")
+
+    def _resize_now(self, new_p: int, *, reason: str,
+                    evicted_ranks=()) -> None:
+        """Elastic resize: drain (the caller sits at an iteration
+        boundary; int(state.step) blocks until the state is whole) ->
+        emergency-save with the residual's partition width in the
+        sidecar meta -> rewrite the elastic.json lineage file for the
+        new P -> durable "resize" record -> ResizeRestart, which
+        dist_trainer maps to exit 46. Everything lands on disk BEFORE
+        the unwind, so the supervisor can relaunch the moment the
+        process exits. A resize below min_fleet is refused: preemption
+        falls back to classic exit-45 semantics, an eviction downgrades
+        to a warning."""
+        from gtopkssgd_tpu.resilience.elastic import (
+            ResizeRestart, mint_lineage_id, write_lineage)
+
+        cfg = self.cfg
+        new_p = int(new_p)
+        floor = max(1, cfg.min_fleet)
+        if new_p < floor:
+            self.logger.warning(
+                "elastic: refusing resize %d -> %d below min_fleet=%d "
+                "(%s)", self.p, new_p, floor, reason)
+            if reason == "preempt":
+                self._preempt_now()
+            return
+        if self._ckpt is None:
+            self.logger.warning(
+                "elastic: resize (%s) at step %d with no out_dir — "
+                "nothing to hand the relaunch; ignoring",
+                reason, int(self.state.step))
+            return
+        step = int(self.state.step)  # blocks: the save must be post-step
+        self._ckpt.save(step, self.state, force=True,
+                        meta={"residual_p": self.p})
+        if self.goodput is not None:
+            self.goodput.mark("ckpt")
+        lineage = dict(self.lineage or {})
+        lineage.update(
+            lineage_id=lineage.get("lineage_id") or mint_lineage_id(),
+            resize_epoch=int(lineage.get("resize_epoch", 0)) + 1,
+            prev_p=self.p, p=new_p, reason=reason,
+            evicted_ranks=[int(r) for r in evicted_ranks],
+            drained_step=step)
+        write_lineage(cfg.out_dir, **lineage)
+        self.lineage = lineage
+        self.metrics.log(
+            "resize", flush=True, step=step, old_p=self.p, new_p=new_p,
+            reason=reason,
+            evicted_ranks=[int(r) for r in evicted_ranks],
+            drained_step=step, restore_step=step,
+            lineage_id=lineage["lineage_id"],
+            resize_epoch=lineage["resize_epoch"])
+        self.logger.warning(
+            "elastic resize (%s): p %d -> %d at step %d; checkpoint + "
+            "lineage durable under %s — relaunch with --resume "
+            "--elastic --nworkers %d", reason, self.p, new_p, step,
+            cfg.out_dir, new_p)
+        raise ResizeRestart(
+            f"resize {self.p} -> {new_p} ({reason}) at step {step}")
+
+    def _check_injected_resize(self, prev: int, new: int) -> None:
+        """Injected resize@K:NEWP / evict_rank:R@K at the step
+        boundary. The durable "inject" record lands either way; without
+        cfg.elastic the request downgrades to a warning, so a chaos
+        spec cannot opt a run into semantics its flags didn't."""
+        inj, cfg = self.injector, self.cfg
+        new_p = inj.pending_resize(prev, new)
+        if new_p is not None:
+            if not cfg.elastic:
+                self.logger.warning(
+                    "inject: resize to P=%d ignored — run without "
+                    "--elastic", new_p)
+            else:
+                self._resize_now(new_p, reason="inject")
+        rank = inj.pending_evict(prev, new)
+        if rank is not None:
+            if not cfg.elastic:
+                self.logger.warning(
+                    "inject: evict_rank %d ignored — run without "
+                    "--elastic", rank)
+            else:
+                self._resize_now(self.p - 1, reason="evict",
+                                 evicted_ranks=(rank,))
+
+    def _maybe_evict(self, step: int) -> None:
+        """Elastic eviction self-check (every evict_after_windows
+        goodput windows): merge this run's per-rank metric shards and
+        act on resilience/elastic.py's ``eviction_decision`` — goodput
+        ``advise()`` names the rank, the straggler EWMA corroborates.
+        Naturally inert for single-shard runs (advise needs >= 2
+        ranks' ledgers) and when the merge cannot be built: the
+        self-check must never take down a healthy run."""
+        cfg = self.cfg
+        try:
+            from gtopkssgd_tpu.obs import fleet
+            from gtopkssgd_tpu.resilience.elastic import eviction_decision
+            merged = fleet.merge([cfg.out_dir])
+            decision = eviction_decision(
+                merged, p=self.p, min_fleet=cfg.min_fleet)
+        except Exception as e:
+            self.logger.debug(
+                "elastic: eviction self-check skipped (%s: %s)",
+                type(e).__name__, e)
+            return
+        if decision is None:
+            return
+        self.logger.warning("elastic: eviction decision %s", decision)
+        self._resize_now(decision["new_p"], reason="evict",
+                         evicted_ranks=(decision["rank"],))
 
     def _apply_recovery(self, pending, prev_state, prev_carry,
                         step: int) -> int:

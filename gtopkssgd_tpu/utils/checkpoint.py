@@ -82,12 +82,20 @@ class CheckpointManager:
     def _integrity_path(self, step: int) -> str:
         return os.path.join(self.directory, f"integrity-{step}.json")
 
-    def _write_integrity(self, step: int, state: Any) -> None:
+    def _write_integrity(self, step: int, state: Any,
+                         meta: Optional[dict] = None) -> None:
         rec = {
             "step": int(step),
             "config_hash": self.config_hash,
             "state_digest": state_digest(state),
         }
+        if meta:
+            # Small json-able facts about the SAVED state that a
+            # restoring run needs before it can build a template — e.g.
+            # the elastic resize path records residual_p, the partition
+            # width of the per-device residual, so a different-P resume
+            # knows the old shape without guessing.
+            rec["meta"] = dict(meta)
         path = self._integrity_path(step)
         tmp = path + ".tmp"
         with open(tmp, "w") as fh:
@@ -155,14 +163,27 @@ class CheckpointManager:
         raise CheckpointMismatch(
             msg + " (pass --allow-ckpt-mismatch to override)")
 
+    def sidecar_meta(self, step: Optional[int] = None) -> dict:
+        """The ``meta`` dict saved alongside ``step`` (default: latest
+        step); {} when the step has no sidecar or the sidecar predates
+        the meta channel."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return {}
+        rec = self._read_integrity(int(step))
+        meta = rec.get("meta") if rec else None
+        return dict(meta) if isinstance(meta, dict) else {}
+
     # ------------------------------------------------------ save/restore
-    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+    def save(self, step: int, state: Any, *, force: bool = False,
+             meta: Optional[dict] = None) -> bool:
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
         self._mgr.wait_until_finished()
         if saved:
-            self._write_integrity(step, state)
+            self._write_integrity(step, state, meta=meta)
             self._prune_integrity()
         return saved
 

@@ -99,6 +99,11 @@ KINDS = frozenset({
                    # per-round intervals, and the worst-link summary;
                    # fsync'd — written BEFORE the link_degraded rule
                    # can halt the run
+    "resize",      # elastic fleet resize (resilience/elastic.py): one
+                   # fsync'd record per resize decision — old_p, new_p,
+                   # reason (preempt|evict|inject), evicted_ranks,
+                   # drained_step, restore_step, lineage_id,
+                   # resize_epoch — durable BEFORE any process exits 46
     "forecast",    # scale-out forecast record (obs/forecast.py): the
                    # hindcast error (predicted vs measured step time on
                    # THIS run), the per-P-target recommendation grid

@@ -1,0 +1,331 @@
+"""Elastic fleet: resize the dp mesh without losing a step.
+
+Pins the elastic tentpole (resilience/elastic.py + the trainer's resize
+hooks): the resize inject grammar, the exit-46 / metrics-kind / lint
+registrations, the residual re-partitioning's EXACT conservation of
+pending gradient mass (arXiv:1911.08772 ties convergence to the
+residual dynamics — a resize that drops or duplicates mass is silently
+wrong), the lineage file contract, the eviction decision, the registry
+lineage join, and — slow-marked — the full 2-proc -> 1-proc dist_trainer
+loop whose post-resize loss trace is bit-identical across two resumes
+of the same resize checkpoint (restore + fold is deterministic).
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from gtopkssgd_tpu.resilience import parse_inject
+from gtopkssgd_tpu.resilience.elastic import (
+    eviction_decision,
+    load_lineage,
+    mint_lineage_id,
+    repartition_buffer,
+    repartition_residual,
+    surviving_ranks,
+    write_lineage,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Same model/flags as benchmarks/obs_gate_smoke.py and test_goodput so
+# the e2e runs below reuse the persistent-cache XLA executable.
+CANON = [
+    "--dnn", "resnet20", "--batch-size", "4",
+    "--compression", "gtopk_layerwise", "--density", "0.01",
+    "--seed", "42", "--eval-batches", "1", "--log-interval", "1",
+    "--obs-interval", "1",
+]
+
+
+def _records(out_dir):
+    path = os.path.join(out_dir, "metrics.jsonl")
+    return [json.loads(line) for line in open(path)]
+
+
+# --------------------------------------------------------- inject grammar
+
+def test_resize_spec_parses_and_roundtrips():
+    (f,) = parse_inject("resize@300:4")
+    assert f.kind == "resize"
+    assert (f.start, f.end) == (300, 300)      # point fault
+    assert f.args == ("4",)
+    assert f.spec() == "resize@300:4"          # canonical round-trip
+
+
+def test_evict_rank_spec_parses_and_roundtrips():
+    (f,) = parse_inject("evict_rank:2@300")
+    assert f.kind == "evict_rank"
+    assert (f.start, f.end) == (300, 300)
+    assert f.args == ("2",)
+    assert f.spec() == "evict_rank:2@300"
+
+
+@pytest.mark.parametrize("bad", [
+    "resize@300",          # missing :NEWP
+    "resize@300:0",        # new_p < 1
+    "resize@300:x",        # non-integer new_p
+    "resize:4@300",        # args ride WHEN, not the head
+    "resize@1-5:2",        # range, not a point
+    "evict_rank@300",      # missing rank
+    "evict_rank:2@1-5",    # range, not a point
+    "evict_rank:-1@300",   # negative rank
+])
+def test_malformed_resize_specs_rejected(bad):
+    with pytest.raises(ValueError):
+        parse_inject(bad)
+
+
+def test_resize_faults_are_consumed_on_fire():
+    from gtopkssgd_tpu.resilience import FaultInjector
+
+    inj = FaultInjector("resize@3:1,evict_rank:0@5")
+    assert inj.pending_resize(0, 2) is None
+    assert inj.pending_resize(2, 3) == 1
+    assert inj.pending_resize(2, 3) is None    # consumed
+    assert inj.pending_evict(4, 5) == 0
+    assert inj.pending_evict(4, 5) is None
+
+
+# ----------------------------------------------------------- registrations
+
+def test_exit_46_registered():
+    from gtopkssgd_tpu.exit_codes import (EXIT_RESIZE_RESTART, REGISTRY,
+                                          describe)
+
+    assert EXIT_RESIZE_RESTART == 46
+    assert EXIT_RESIZE_RESTART in REGISTRY
+    assert "resize" in describe(EXIT_RESIZE_RESTART)
+
+
+def test_resize_kind_registered_and_durable():
+    from gtopkssgd_tpu.analysis.rules import DurableEventRule
+    from gtopkssgd_tpu.utils.metrics import KINDS
+
+    assert "resize" in KINDS
+    assert "resize" in DurableEventRule.DURABLE_KINDS
+
+
+# ------------------------------------------------------- re-partitioning
+# Exactness strategy: integer-valued fp32 buffers — every fold add is
+# exact in fp32, so conservation asserts == not approx.
+
+def _int_valued(shape, rng, signed=True):
+    lo = -100 if signed else 0
+    return rng.integers(lo, 100, size=shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("old_p,new_p", [
+    (4, 2),    # pow2 -> pow2 shrink
+    (4, 3),    # pow2 -> non-pow2 shrink
+    (2, 1),    # shrink to a single worker
+    (3, 2),    # non-pow2 shrink
+    (2, 4),    # grow
+    (3, 3),    # identity
+])
+def test_repartition_conserves_pending_mass_exactly(old_p, new_p, rng):
+    buf = _int_valued((old_p, 64), rng, signed=False)
+    out = repartition_buffer(buf, new_p)
+    assert out.shape == (new_p, 64) and out.dtype == buf.dtype
+    # Non-negative integer-valued fp32: sum(|residual|) conserved EXACTLY.
+    assert float(np.abs(out).sum()) == float(np.abs(buf).sum())
+
+
+@pytest.mark.parametrize("old_p,new_p", [(4, 2), (4, 3), (3, 2), (2, 1)])
+def test_repartition_column_sums_exact_signed(old_p, new_p, rng):
+    buf = _int_valued((old_p, 33), rng, signed=True)
+    out = repartition_buffer(buf, new_p)
+    # The fold adds orphaned rows into survivors: each COLUMN's total
+    # pending mass (signed) is conserved exactly.
+    np.testing.assert_array_equal(out.sum(axis=0), buf.sum(axis=0))
+
+
+def test_grow_then_shrink_back_is_identity(rng):
+    buf = _int_valued((2, 17), rng)
+    grown = repartition_buffer(buf, 4)
+    np.testing.assert_array_equal(grown[:2], buf)       # copied rows
+    assert not grown[2:].any()                          # zero rows
+    back = repartition_buffer(grown, 2)
+    np.testing.assert_array_equal(back, buf)            # exact round-trip
+
+
+def test_shrink_fold_matches_masked_fold_semantics(rng):
+    # out[r % new_p] += buf[r] for each orphaned row — spelled out.
+    buf = _int_valued((5, 8), rng)
+    out = repartition_buffer(buf, 2)
+    want = buf[:2].copy()
+    for r in range(2, 5):
+        want[r % 2] += buf[r]
+    np.testing.assert_array_equal(out, want)
+
+
+def test_repartition_rejects_bad_inputs():
+    with pytest.raises(ValueError):
+        repartition_buffer(np.float32(3.0), 2)          # no [P] dim
+    with pytest.raises(ValueError):
+        repartition_buffer(np.zeros((2, 4), np.float32), 0)
+
+
+def test_repartition_residual_all_layouts(rng):
+    flat = _int_valued((4, 16), rng)                    # gtopk
+    tup = (_int_valued((4, 8), rng), _int_valued((4, 3), rng))
+    dct = {"v": _int_valued((4, 8), rng),               # momentum corr.
+           "u": _int_valued((4, 8), rng)}
+    out_flat = repartition_residual(flat, 2)
+    out_tup = repartition_residual(tup, 2)
+    out_dct = repartition_residual(dct, 2)
+    np.testing.assert_array_equal(out_flat, repartition_buffer(flat, 2))
+    for a, b in zip(out_tup, tup):
+        np.testing.assert_array_equal(a, repartition_buffer(b, 2))
+    for key in dct:
+        np.testing.assert_array_equal(out_dct[key],
+                                      repartition_buffer(dct[key], 2))
+
+
+# ----------------------------------------------------------------- lineage
+
+def test_lineage_roundtrip(tmp_path):
+    d = str(tmp_path)
+    assert load_lineage(d) is None                      # fresh start
+    lid = mint_lineage_id()
+    assert len(lid) == 16
+    write_lineage(d, lineage_id=lid, resize_epoch=0, p=2)
+    rec = load_lineage(d)
+    assert rec == {"lineage_id": lid, "resize_epoch": 0, "p": 2}
+    write_lineage(d, lineage_id=lid, resize_epoch=1, p=1,
+                  prev_p=2, reason="inject")
+    assert load_lineage(d)["resize_epoch"] == 1
+
+
+def test_lineage_malformed_reads_as_none(tmp_path):
+    d = str(tmp_path)
+    with open(os.path.join(d, "elastic.json"), "w") as fh:
+        fh.write("{torn")
+    assert load_lineage(d) is None                      # never kills resume
+    with open(os.path.join(d, "elastic.json"), "w") as fh:
+        fh.write("{}")
+    assert load_lineage(d) is None                      # no lineage_id
+    assert load_lineage(None) is None
+
+
+def test_surviving_ranks_renumber():
+    assert surviving_ranks(4, [1]) == [0, 2, 3]
+    assert surviving_ranks(4, []) == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------- eviction
+
+def _merged(fracs, stragglers=()):
+    return {
+        "goodput_by_rank": {
+            r: {"goodput_frac": f, "wall_s": 100.0,
+                "goodput_s": 100.0 * f, "wait_s": 100.0 * (1 - f)}
+            for r, f in fracs.items()},
+        "stragglers": list(stragglers),
+    }
+
+
+def test_eviction_names_the_outlier_rank():
+    merged = _merged({0: 0.45, 1: 0.92, 2: 0.95},
+                     [{"slowest_rank": 0, "persistent": True}])
+    d = eviction_decision(merged, p=3, min_fleet=1, margin=0.1)
+    assert d is not None
+    assert d["rank"] == 0 and d["new_p"] == 2
+    assert d["reason"] == "evict"
+    assert d["persistent_straggler"] is True
+    assert d["dominant_badput"] == "wait"
+
+
+def test_eviction_refuses_below_min_fleet():
+    merged = _merged({0: 0.45, 1: 0.95})
+    assert eviction_decision(merged, p=2, min_fleet=2) is None
+    # p=1: nothing to evict into, regardless of floor.
+    assert eviction_decision(_merged({0: 0.4}), p=1, min_fleet=1) is None
+
+
+def test_eviction_none_for_healthy_fleet():
+    merged = _merged({0: 0.93, 1: 0.92, 2: 0.95})
+    assert eviction_decision(merged, p=3, min_fleet=1, margin=0.1) is None
+
+
+def test_eviction_without_corroborating_straggler_row():
+    merged = _merged({0: 0.45, 1: 0.92, 2: 0.95})
+    d = eviction_decision(merged, p=3, min_fleet=1, margin=0.1)
+    assert d is not None and d["persistent_straggler"] is False
+
+
+# ---------------------------------------------------------- registry join
+
+def test_registry_lineage_join():
+    from gtopkssgd_tpu.obs import registry as _registry
+
+    lid = "a" * 16
+    entries = [
+        {"config_hash": "h2", "lineage_id": lid, "resize_epoch": 0,
+         "stats": {"loss_last": 2.0}},
+        {"config_hash": "hx", "stats": {}},              # unrelated run
+        {"config_hash": "h1", "lineage_id": lid, "resize_epoch": 1,
+         "stats": {"loss_last": 1.5}},
+    ]
+    # pick_baseline: no hash match, but the lineage joins the segments.
+    base = _registry.pick_baseline(entries[-1], entries[:-1])
+    assert base is entries[0]
+    # history under the PRE-resize hash keeps the post-resize segment.
+    rows = _registry.history_rows(entries, config_hash="h2")
+    assert len(rows) == 2
+    lineage_col = _registry.HISTORY_HEADER.index("lineage")
+    assert rows[0][lineage_col] == f"{lid[:8]}:0"
+    assert rows[1][lineage_col] == f"{lid[:8]}:1"
+    for row in rows:
+        assert len(row) == len(_registry.HISTORY_HEADER)
+    # Non-elastic entries render "-" and are filtered as before.
+    assert _registry.history_rows(entries, config_hash="hx")[0][
+        lineage_col] == "-"
+
+
+# ------------------------------------------------------------------- e2e
+
+@pytest.mark.slow  # three full dist_trainer runs + jit compiles
+def test_resize_e2e_shrink_and_deterministic_resume(tmp_path):
+    """The chaos resize loop end to end: 2-proc run resizes to 1 at
+    step 3 (exit 46, durable resize record, lineage), and TWO resumes
+    from the same resize checkpoint produce bit-identical loss traces —
+    the restore + residual fold is deterministic, so the post-resize
+    trajectory is well-defined (the elastic analog of the preempt
+    path's exact-resume pin)."""
+    from gtopkssgd_tpu import dist_trainer
+    from gtopkssgd_tpu.exit_codes import EXIT_RESIZE_RESTART
+
+    a = str(tmp_path / "pre")
+    rc = dist_trainer.main(CANON + [
+        "--nworkers", "2", "--elastic", "--inject", "resize@3:1",
+        "--num-iters", "6", "--out-dir", a])
+    assert rc == EXIT_RESIZE_RESTART
+    resizes = [r for r in _records(a) if r["kind"] == "resize"]
+    assert len(resizes) == 1
+    rz = resizes[0]
+    assert rz["old_p"] == 2 and rz["new_p"] == 1
+    assert rz["reason"] == "inject" and rz["drained_step"] == 3
+    assert rz["lineage_id"] and rz["resize_epoch"] == 1
+
+    def _resume(name):
+        d = str(tmp_path / name)
+        os.makedirs(d)
+        shutil.copytree(os.path.join(a, "ckpt"), os.path.join(d, "ckpt"))
+        shutil.copy2(os.path.join(a, "elastic.json"),
+                     os.path.join(d, "elastic.json"))
+        rc = dist_trainer.main(CANON + [
+            "--nworkers", "1", "--elastic", "--resume",
+            "--num-iters", "3", "--out-dir", d])
+        assert rc == 0
+        trace = [(r["step"], r["loss"]) for r in _records(d)
+                 if r["kind"] == "train"]
+        assert trace and trace[0][0] == 4       # continues, no lost step
+        lineage = json.load(open(os.path.join(d, "elastic.json")))
+        assert lineage["lineage_id"] == rz["lineage_id"]
+        return trace
+
+    assert _resume("post1") == _resume("post2")   # bit-identical traces
